@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device CPU mesh before jax imports.
+
+This mirrors how the reference's distributed layer is tested without a
+cluster (SURVEY.md §4): a virtual 8-device CPU platform exercises the
+shard_map/psum code paths that run over ICI on real TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,63 @@
+"""Command-line entry point.
+
+Capability match for pbrt-v3 src/main/pbrt.cpp: flag parsing into Options
+(--nthreads, --outfile, --quick, --quiet, --cropwindow, ...) plus the
+TPU-specific runtime tier (--mesh for the device mesh shape, --spp-chunk
+for sample chunking) per SURVEY.md §5.6's two-tier config system.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpu_pbrt.scene.api import Options, render_file
+from tpu_pbrt.utils.error import PbrtError
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-pbrt",
+        description="TPU-native physically based renderer (pbrt-v3 scene compatible)",
+    )
+    p.add_argument("scenes", nargs="+", help=".pbrt scene file(s) to render")
+    p.add_argument("--outfile", "-o", default="", help="output image filename (overrides scene Film)")
+    p.add_argument("--quick", action="store_true", help="reduce samples/resolution for a fast preview")
+    p.add_argument("--quiet", action="store_true", help="suppress progress/warning messages")
+    p.add_argument("--verbose", "-v", action="store_true", help="verbose logging")
+    p.add_argument(
+        "--cropwindow",
+        nargs=4,
+        type=float,
+        metavar=("X0", "X1", "Y0", "Y1"),
+        help="render only this fraction of the image",
+    )
+    p.add_argument("--nthreads", type=int, default=0, help="host threads for scene compile (0 = all)")
+    p.add_argument("--mesh", default="", help="TPU device mesh shape, e.g. '8' or '2,4' (default: all devices)")
+    p.add_argument("--spp-chunk", type=int, default=0, help="samples per render chunk (0 = auto)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    opts = Options(
+        n_threads=args.nthreads,
+        quick_render=args.quick,
+        quiet=args.quiet,
+        verbose=args.verbose,
+        image_file=args.outfile,
+        crop_window=tuple(args.cropwindow) if args.cropwindow else None,
+        mesh_shape=tuple(int(x) for x in args.mesh.split(",")) if args.mesh else None,
+        spp_chunk=args.spp_chunk,
+    )
+    for scene in args.scenes:
+        try:
+            render_file(scene, opts)
+        except PbrtError as e:
+            print(f"tpu-pbrt: {e}", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
