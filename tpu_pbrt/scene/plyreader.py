@@ -124,7 +124,6 @@ def _read_ascii(body: bytes, elements, out, path):
 
     for name, count, props in elements:
         if name == "vertex":
-            scalar_props = [p for p in props if p[2] is None]
             rows = np.empty((count, len(props)), dtype=np.float64)
             for i in range(count):
                 vals = []
