@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Benchmark driver: renders the killeroo-simple-class workload and prints
+one JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+The workload mirrors BASELINE.json's killeroo-simple config (PathIntegrator,
+matte trimesh, area light) with a procedural ~128k-triangle mesh standing in
+for the PLY (pbrt-v3-scenes is not available in this environment). Metric is
+Mray/s (rays actually traced / steady-state wall time, counted in-kernel),
+judged against the north-star 100 Mray/s target. A warmup pass excludes XLA
+compilation from the timing, matching how the reference's numbers would
+exclude its BVH build.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    spp = int(os.environ.get("BENCH_SPP", "64"))
+    res = int(os.environ.get("BENCH_RES", "512"))
+
+    from tpu_pbrt.scenes import compile_api, make_killeroo_like
+
+    api = make_killeroo_like(res=res, spp=spp)
+    scene, integ = compile_api(api)
+
+    # warmup run with identical shapes so the timed run hits the jit cache
+    integ.render(scene)
+    result = integ.render(scene)
+    north_star = 100.0  # Mray/s on v5e-8 (BASELINE.json north_star)
+    print(
+        json.dumps(
+            {
+                "metric": "killeroo_like_path_mray_per_sec",
+                "value": round(result.mray_per_sec, 3),
+                "unit": "Mray/s",
+                "vs_baseline": round(result.mray_per_sec / north_star, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,14 +1,25 @@
-"""Test configuration: force an 8-device CPU mesh before jax imports.
+"""Test configuration: force an 8-device CPU mesh before any test imports.
 
 This mirrors how the reference's distributed layer is tested without a
 cluster (SURVEY.md §4): a virtual 8-device CPU platform exercises the
 shard_map/psum code paths that run over ICI on real TPU hardware.
+
+The override is unconditional and uses jax.config (not just the env var):
+the harness's TPU plugin registers itself via sitecustomize at interpreter
+startup and would otherwise claim the default backend. Unit tests must be
+hardware-independent and deterministic. Set TPU_PBRT_TEST_PLATFORM=axon to
+run the suite on real hardware instead.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_platform = os.environ.get("TPU_PBRT_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)

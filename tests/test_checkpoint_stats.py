@@ -1,0 +1,78 @@
+"""Checkpoint/resume bit-compatibility (SURVEY.md §5.4) and the stats
+registry report format (§5.1/§5.5)."""
+
+import numpy as np
+
+from tpu_pbrt.parallel.checkpoint import load_checkpoint, save_checkpoint
+from tpu_pbrt.scenes import compile_api, make_cornell
+from tpu_pbrt.utils.stats import STATS, ProgressReporter, StatsRegistry
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tmp_path):
+        api = make_cornell(res=16, spp=2, integrator="directlighting", maxdepth=1)
+        scene, integ = compile_api(api)
+        st = scene.film.init_state()
+        p = str(tmp_path / "ck.npz")
+        save_checkpoint(p, st, 7, 1234)
+        st2, nxt, rays = load_checkpoint(p)
+        assert nxt == 7 and rays == 1234
+        assert np.array_equal(np.asarray(st.rgb), np.asarray(st2.rgb))
+
+    def test_resume_bit_identical(self, tmp_path):
+        """A render interrupted at a checkpoint and resumed produces the
+        same image as an uninterrupted one (counter-based RNG + idempotent
+        chunks)."""
+        import os
+
+        os.environ["TPU_PBRT_CHUNK"] = "1024"  # force multiple chunks
+        try:
+            api = make_cornell(res=16, spp=8, integrator="directlighting", maxdepth=2)
+            scene, integ = compile_api(api)
+            full = integ.render(scene)
+
+            # simulate interruption: checkpoint after every chunk, then
+            # resume from the halfway checkpoint
+            p = str(tmp_path / "resume.npz")
+            api2 = make_cornell(res=16, spp=8, integrator="directlighting", maxdepth=2)
+            scene2, integ2 = compile_api(api2)
+            integ2.render(scene2, checkpoint_path=p, checkpoint_every=1)
+            st, nxt, rays = load_checkpoint(p)
+            # rewind the cursor to mid-render and resume
+            save_checkpoint(p, scene2.film.init_state(), 0, 0)
+            r3 = integ2.render(scene2, checkpoint_path=p, checkpoint_every=1)
+            assert np.allclose(full.image, r3.image, atol=1e-6)
+        finally:
+            del os.environ["TPU_PBRT_CHUNK"]
+
+
+class TestStats:
+    def test_report_format(self):
+        reg = StatsRegistry()
+        reg.counter("Integrator/Camera rays traced", 100)
+        reg.memory_counter("Scene/BVH memory", 3 << 20)
+        reg.percent("Intersections/Regular ray intersection tests", 40, 100)
+        reg.ratio("Scene/Rays per sample", 30, 10)
+        reg.distribution("Integrator/Path length", 3)
+        reg.distribution("Integrator/Path length", 5)
+        with reg.phase("Accelerator/Intersect"):
+            pass
+        text = reg.report()
+        assert "Statistics:" in text
+        assert "Camera rays traced" in text
+        assert "3.00 MiB" in text
+        assert "(40.00%)" in text
+        assert "(3.00x)" in text
+        assert "4.000 avg" in text
+        assert "Accelerator/Intersect" in text
+
+    def test_global_registry_counts(self):
+        STATS.counter("Test/widget", 2)
+        STATS.counter("Test/widget", 3)
+        assert STATS.counters["Test/widget"] >= 5
+
+    def test_progress_quiet(self):
+        p = ProgressReporter(10, "t", quiet=True)
+        for _ in range(10):
+            p.update()
+        p.done()

@@ -1,0 +1,142 @@
+"""Film/filter/imageio tests (pbrt src/tests/imageio.cpp counterpart +
+Film semantics: filter-weighted accumulation, crop windows, splats,
+associative merge)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tpu_pbrt.core.film import Film, merge_film
+from tpu_pbrt.core.filters import FilterSpec, make_filter
+from tpu_pbrt.scene.paramset import ParamSet
+from tpu_pbrt.utils import imageio
+
+
+class TestFilters:
+    def test_box(self):
+        f = FilterSpec("box", 0.5, 0.5, 0, 0)
+        assert float(f.evaluate(jnp.float32(0.2), jnp.float32(-0.3))) == 1.0
+        assert float(f.evaluate(jnp.float32(0.6), jnp.float32(0.0))) == 0.0
+
+    def test_triangle(self):
+        f = FilterSpec("triangle", 2.0, 2.0, 0, 0)
+        assert abs(float(f.evaluate(jnp.float32(0.0), jnp.float32(0.0))) - 4.0) < 1e-6
+        assert float(f.evaluate(jnp.float32(2.1), jnp.float32(0.0))) == 0.0
+
+    def test_gaussian_positive_inside(self):
+        f = make_filter("gaussian", ParamSet())
+        v = float(f.evaluate(jnp.float32(1.0), jnp.float32(1.0)))
+        assert v > 0.0
+        assert float(f.evaluate(jnp.float32(2.5), jnp.float32(0.0))) == 0.0
+
+    def test_mitchell_partition(self):
+        """Mitchell-Netravali sums to ~1 over integer offsets."""
+        f = make_filter("mitchell", ParamSet())
+        xs = jnp.arange(-2, 3, dtype=jnp.float32)[:, None] + 0.3
+        ys = jnp.arange(-2, 3, dtype=jnp.float32)[None, :] - 0.1
+        total = float(jnp.sum(f.evaluate(xs / 1.0, ys / 1.0) * 0 + f.evaluate(xs, ys)))
+        assert abs(total - 1.0) < 0.05
+
+
+class TestFilm:
+    def test_box_filter_single_pixel(self):
+        film = Film(resolution=(8, 8), filt=FilterSpec("box", 0.5, 0.5, 0, 0), filename="")
+        st = film.init_state()
+        p = jnp.asarray([[3.5, 4.5]])  # center of pixel (3,4)
+        st = film.add_samples(st, p, jnp.asarray([[2.0, 4.0, 6.0]]))
+        img = film.develop(st)
+        assert np.allclose(img[4, 3], [2, 4, 6])
+        assert img.sum() == pytest.approx(12.0)
+
+    def test_filter_weight_normalisation(self):
+        """Constant-radiance samples develop to the constant regardless of
+        filter: sum(w*L)/sum(w) == L."""
+        film = Film(resolution=(8, 8), filt=make_filter("gaussian", ParamSet()), filename="")
+        st = film.init_state()
+        rng = np.random.default_rng(0)
+        p = jnp.asarray(rng.uniform(0, 8, (512, 2)).astype(np.float32))
+        L = jnp.broadcast_to(jnp.asarray([1.5, 1.5, 1.5]), (512, 3))
+        st = film.add_samples(st, p, L)
+        img = film.develop(st)
+        inner = img[2:6, 2:6]
+        assert np.allclose(inner, 1.5, atol=1e-4)
+
+    def test_merge_is_addition(self):
+        film = Film(resolution=(4, 4), filename="")
+        a = film.init_state()
+        b = film.init_state()
+        p = jnp.asarray([[1.5, 1.5]])
+        a = film.add_samples(a, p, jnp.asarray([[1.0, 0.0, 0.0]]))
+        b = film.add_samples(b, p, jnp.asarray([[0.0, 1.0, 0.0]]))
+        m = merge_film(a, b)
+        img = film.develop(m)
+        assert np.allclose(img[1, 1], [0.5, 0.5, 0.0])  # averaged by weights
+
+    def test_crop_window(self):
+        film = Film(resolution=(8, 8), crop_window=(0.25, 0.75, 0.25, 0.75), filename="")
+        x0, x1, y0, y1 = film.cropped_pixel_bounds
+        assert (x0, x1, y0, y1) == (2, 6, 2, 6)
+        st = film.init_state()
+        # sample outside the crop: dropped
+        st = film.add_samples(st, jnp.asarray([[0.5, 0.5], [3.5, 3.5]]), jnp.ones((2, 3)))
+        img = film.develop(st)
+        assert img.shape == (4, 4, 3)
+        assert img[1, 1].sum() > 0
+        assert float(np.asarray(st.weight)[0, 0]) == 0.0
+
+    def test_splat(self):
+        film = Film(resolution=(4, 4), filename="")
+        st = film.init_state()
+        st = film.add_splats(st, jnp.asarray([[2.2, 1.7]]), jnp.asarray([[3.0, 0.0, 0.0]]))
+        img = film.develop(st, splat_scale=0.5)
+        assert np.allclose(img[1, 2], [1.5, 0, 0])
+
+    def test_nan_rejected(self):
+        film = Film(resolution=(4, 4), filename="")
+        st = film.init_state()
+        st = film.add_samples(st, jnp.asarray([[1.5, 1.5]]), jnp.asarray([[np.nan, 1.0, 1.0]]))
+        img = film.develop(st)
+        assert np.isfinite(img).all()
+        assert img[1, 1, 1] == 0.0  # whole sample dropped
+
+
+class TestImageIO:
+    @pytest.mark.parametrize("ext", ["exr", "pfm"])
+    def test_float_roundtrip(self, tmp_path, ext):
+        rng = np.random.default_rng(1)
+        img = (rng.uniform(0, 4, (13, 17, 3)) ** 2).astype(np.float32)
+        p = str(tmp_path / f"t.{ext}")
+        imageio.write_image(p, img)
+        back = imageio.read_image(p)
+        tol = 2e-3 * img.max() if ext == "exr" else 1e-6  # half-float quantisation
+        assert back.shape == img.shape
+        assert np.abs(back - img).max() < tol
+
+    def test_exr_float32_exact(self, tmp_path):
+        rng = np.random.default_rng(2)
+        img = rng.uniform(0, 100, (20, 31, 3)).astype(np.float32)
+        p = str(tmp_path / "t32.exr")
+        imageio.write_exr(p, img, half=False)
+        back = imageio.read_image(p)
+        assert np.array_equal(back, img)
+
+    def test_png_roundtrip_8bit(self, tmp_path):
+        rng = np.random.default_rng(3)
+        img = rng.uniform(0, 1, (9, 11, 3)).astype(np.float32)
+        p = str(tmp_path / "t.png")
+        imageio.write_image(p, img)
+        back = imageio.read_image(p)
+        # 8-bit + sRGB roundtrip tolerance
+        assert np.abs(back - img).max() < 0.01
+
+    def test_tga_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(4)
+        img = rng.uniform(0, 1, (6, 7, 3)).astype(np.float32)
+        p = str(tmp_path / "t.tga")
+        imageio.write_image(p, img)
+        back = imageio.read_image(p)
+        assert np.abs(back - img).max() < 0.01
+
+    def test_gamma_correct_inverse(self):
+        v = np.linspace(0, 1, 64)
+        assert np.allclose(imageio.inverse_gamma_correct(imageio.gamma_correct(v)), v, atol=1e-6)

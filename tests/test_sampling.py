@@ -1,0 +1,171 @@
+"""Sampling-layer tests (pbrt src/tests/sampling.cpp counterpart):
+distribution correctness of the warps, CDF sampling, stratification, the
+stateless RNG, and MIS heuristics."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_pbrt.core import sampling as sm
+
+
+def _u(n, salt):
+    i = jnp.arange(n)
+    return np.asarray(sm.uniform_float(i, salt))
+
+
+class TestRNG:
+    def test_uniformity(self):
+        u = _u(100_000, 1)
+        assert 0.0 <= u.min() and u.max() < 1.0
+        # first three moments of U[0,1)
+        assert abs(u.mean() - 0.5) < 3e-3
+        assert abs((u**2).mean() - 1 / 3) < 3e-3
+        hist, _ = np.histogram(u, bins=64, range=(0, 1))
+        chi2 = ((hist - len(u) / 64) ** 2 / (len(u) / 64)).sum()
+        assert chi2 < 64 * 2.0, f"chi2 {chi2}"
+
+    def test_streams_uncorrelated(self):
+        a = _u(50_000, 1)
+        b = _u(50_000, 2)
+        r = np.corrcoef(a, b)[0, 1]
+        assert abs(r) < 0.02
+
+    def test_deterministic(self):
+        assert np.array_equal(_u(100, 7), _u(100, 7))
+
+
+class TestWarps:
+    def test_concentric_disk_in_unit_disk(self):
+        n = 20_000
+        u1, u2 = _u(n, 3), _u(n, 4)
+        x, y = sm.concentric_sample_disk(jnp.asarray(u1), jnp.asarray(u2))
+        r2 = np.asarray(x) ** 2 + np.asarray(y) ** 2
+        assert r2.max() <= 1.0 + 1e-6
+        # uniform density: mean radius^2 = 1/2
+        assert abs(r2.mean() - 0.5) < 5e-3
+
+    def test_cosine_hemisphere_mean_cos(self):
+        n = 50_000
+        d = np.asarray(sm.cosine_sample_hemisphere(jnp.asarray(_u(n, 5)), jnp.asarray(_u(n, 6))))
+        assert (d[:, 2] >= 0).all()
+        # E[cos theta] under p = cos/pi is 2/3
+        assert abs(d[:, 2].mean() - 2 / 3) < 5e-3
+
+    def test_uniform_sphere(self):
+        n = 50_000
+        d = np.asarray(sm.uniform_sample_sphere(jnp.asarray(_u(n, 8)), jnp.asarray(_u(n, 9))))
+        assert np.allclose(np.linalg.norm(d, axis=-1), 1.0, atol=1e-5)
+        assert np.abs(d.mean(axis=0)).max() < 0.02
+
+    def test_uniform_triangle_barycentric(self):
+        n = 50_000
+        b0, b1 = sm.uniform_sample_triangle(jnp.asarray(_u(n, 10)), jnp.asarray(_u(n, 11)))
+        b0, b1 = np.asarray(b0), np.asarray(b1)
+        assert (b0 >= 0).all() and (b1 >= 0).all() and (b0 + b1 <= 1 + 1e-6).all()
+        # uniform over the simplex: E[b0] = E[b1] = 1/3
+        assert abs(b0.mean() - 1 / 3) < 5e-3
+        assert abs(b1.mean() - 1 / 3) < 5e-3
+
+    def test_cone_pdf_normalises(self):
+        ct = 0.7
+        n = 50_000
+        d = np.asarray(sm.uniform_sample_cone(jnp.asarray(_u(n, 12)), jnp.asarray(_u(n, 13)), ct))
+        assert (d[:, 2] >= ct - 1e-5).all()
+        # solid angle of the cone = 2pi(1-ct); pdf = 1/that
+        assert abs(float(sm.uniform_cone_pdf(jnp.float32(ct))) - 1 / (2 * np.pi * (1 - ct))) < 1e-6
+
+
+class TestStratified:
+    def test_stratified_1d_covers_strata(self):
+        n_strata = 16
+        s = jnp.arange(n_strata)
+        vals = np.asarray(sm.stratified_1d(s, n_strata, 123, 7))
+        cells = np.floor(vals * n_strata).astype(int)
+        assert sorted(cells.tolist()) == list(range(n_strata)), cells
+
+    def test_stratified_2d_covers_grid(self):
+        sx = sy = 4
+        s = jnp.arange(sx * sy)
+        u, v = sm.stratified_2d(s, sx, sy, 55, 9)
+        cx = np.floor(np.asarray(u) * sx).astype(int)
+        cy = np.floor(np.asarray(v) * sy).astype(int)
+        assert sorted((cy * sx + cx).tolist()) == list(range(sx * sy))
+
+    def test_permutation_is_bijection(self):
+        for n in (5, 8, 13, 100):
+            p = np.asarray(sm.permutation_element(jnp.arange(n), n, jnp.uint32(17)))
+            assert sorted(p.tolist()) == list(range(n)), (n, p)
+
+
+class TestLowDiscrepancy:
+    def test_radical_inverse_base2(self):
+        got = np.asarray(sm.radical_inverse_base2(jnp.arange(8)))
+        expect = [0, 0.5, 0.25, 0.75, 0.125, 0.625, 0.375, 0.875]
+        assert np.allclose(got, expect, atol=1e-6)
+
+    def test_sobol_2d_stratified(self):
+        """(0,2)-sequence property: first 16 points stratify every 4x4
+        elementary interval."""
+        x, y = sm.sobol_2d(jnp.arange(16))
+        cx = np.floor(np.asarray(x) * 4).astype(int)
+        cy = np.floor(np.asarray(y) * 4).astype(int)
+        assert sorted((cy * 4 + cx).tolist()) == list(range(16))
+
+
+class TestDistribution1D:
+    def test_discrete_pmf(self):
+        d = sm.Distribution1D.build([1.0, 3.0, 0.0, 4.0])
+        u = jnp.asarray(_u(100_000, 21))
+        idx, pmf = d.sample_discrete(u)
+        idx = np.asarray(idx)
+        counts = np.bincount(idx, minlength=4) / len(idx)
+        assert np.allclose(counts, [1 / 8, 3 / 8, 0, 4 / 8], atol=5e-3)
+        assert np.allclose(np.asarray(pmf), counts[idx], atol=5e-3)
+
+    def test_continuous_pdf_integrates(self):
+        f = [0.2, 1.0, 2.0, 0.5, 0.3]
+        d = sm.Distribution1D.build(f)
+        u = jnp.asarray(_u(100_000, 22))
+        x, pdf, _ = d.sample_continuous(u)
+        x = np.asarray(x)
+        # E[1/pdf] over samples = measure of domain = 1
+        assert abs(np.mean(1.0 / np.asarray(pdf)) - 1.0) < 5e-3
+        # histogram matches f (normalized)
+        hist, _ = np.histogram(x, bins=5, range=(0, 1), density=True)
+        fn = np.asarray(f) / np.mean(f)
+        assert np.allclose(hist, fn, rtol=0.05)
+
+
+class TestDistribution2D:
+    def test_sample_matches_pdf(self):
+        rng = np.random.default_rng(3)
+        f = rng.uniform(0.1, 2.0, (8, 16))
+        d = sm.Distribution2D.build(f)
+        n = 200_000
+        u1 = jnp.asarray(_u(n, 31))
+        u2 = jnp.asarray(_u(n, 32))
+        (u, v), pdf = d.sample_continuous(u1, u2)
+        # cross-check pdf() against the sampling pdf
+        pdf2 = d.pdf(u, v)
+        assert np.allclose(np.asarray(pdf), np.asarray(pdf2), rtol=1e-4)
+        # E[1/pdf] = domain measure = 1
+        assert abs(np.mean(1.0 / np.asarray(pdf)) - 1.0) < 5e-3
+        # cell frequencies proportional to f
+        iu = np.clip((np.asarray(u) * 16).astype(int), 0, 15)
+        iv = np.clip((np.asarray(v) * 8).astype(int), 0, 7)
+        counts = np.zeros((8, 16))
+        np.add.at(counts, (iv, iu), 1.0)
+        counts /= counts.sum()
+        expect = f / f.sum()
+        assert np.abs(counts - expect).max() < 0.003
+
+
+class TestMIS:
+    def test_power_heuristic_partition(self):
+        """w_f(pf,pg) + w_g(pg,pf) = 1 — the MIS weights partition unity."""
+        pf = jnp.asarray(_u(1000, 41)) * 5
+        pg = jnp.asarray(_u(1000, 42)) * 5
+        wf = np.asarray(sm.power_heuristic(1, pf, 1, pg))
+        wg = np.asarray(sm.power_heuristic(1, pg, 1, pf))
+        assert np.allclose(wf + wg, 1.0, atol=1e-5)

@@ -183,11 +183,14 @@ def _ray_traverse(bvh, tri_verts, o, d, t_max, any_hit: bool):
         is_leaf = n_prims > 0
         test_leaf = hit_box & is_leaf
 
-        # unrolled masked leaf tests
+        # unrolled masked leaf tests; clamp the gather index — the final
+        # leaf's off+k can run past the triangle array (masked out by
+        # k < n_prims, but the gather itself must stay in bounds on TPU)
         t_new, prim_new, b0_new, b1_new = s.t, s.prim, s.b0, s.b1
         off = bvh["prim_offset"][node]
+        n_tris = tri_verts.shape[0]
         for k in range(MAX_LEAF_PRIMS):
-            pidx = off + k
+            pidx = jnp.minimum(off + k, n_tris - 1)
             tri = tri_verts[pidx]
             h, th, b0h, b1h = intersect_triangle(o, d, tri[0], tri[1], tri[2], t_new)
             take = test_leaf & (k < n_prims) & h

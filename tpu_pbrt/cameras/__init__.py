@@ -1,0 +1,170 @@
+"""Cameras: host-side construction + device-side batched ray generation.
+
+Capability match for pbrt-v3 src/cameras/ (perspective, orthographic,
+environment, realistic) and src/core/camera.{h,cpp}. The projective
+transform chain (screen window -> raster -> camera) is built on the host
+exactly as in ProjectiveCamera's constructor; the device side is a single
+vectorized ray-gen over a batch of CameraSamples (film + lens points), with
+depth of field via concentric lens sampling.
+
+The realistic camera's lens-element tracing is approximated by the thin-lens
+model (same params: aperture + focus); full element tables are a later
+extension (SURVEY.md §7 stage 9).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_pbrt.core import transform as xf
+from tpu_pbrt.core.sampling import concentric_sample_disk
+from tpu_pbrt.core.vecmath import normalize
+from tpu_pbrt.utils.error import Error, Warning
+
+CAM_PERSPECTIVE = 0
+CAM_ORTHOGRAPHIC = 1
+CAM_ENVIRONMENT = 2
+
+
+class CompiledCamera(NamedTuple):
+    """Device-ready camera. Matrices are float32 (4,4); row-vector math is
+    done explicitly in generate_rays."""
+
+    cam_type: int  # static python int — selects the trace path
+    raster_to_camera: jnp.ndarray  # (4,4)
+    camera_to_world: jnp.ndarray  # (4,4)
+    lens_radius: jnp.ndarray  # scalar
+    focal_distance: jnp.ndarray  # scalar
+    shutter_open: float
+    shutter_close: float
+    full_res: tuple  # (x, y)
+
+
+def _screen_window(aspect: float, params) -> tuple:
+    sw = params.find_float("screenwindow")
+    if aspect > 1.0:
+        screen = [-aspect, aspect, -1.0, 1.0]
+    else:
+        screen = [-1.0, 1.0, -1.0 / aspect, 1.0 / aspect]
+    if sw is not None:
+        if len(sw) == 4:
+            screen = [sw[0], sw[1], sw[2], sw[3]]
+        else:
+            Error('"screenwindow" should have four values')
+    return screen
+
+
+def make_camera(name: str, params, cam_to_world: xf.Transform, full_res, shutter=(0.0, 1.0)):
+    """api.cpp MakeCamera: string-dispatched factory -> CompiledCamera."""
+    res_x, res_y = full_res
+    aspect = params.find_one_float("frameaspectratio", res_x / res_y)
+    lens_radius = params.find_one_float("lensradius", 0.0)
+    focal = params.find_one_float("focaldistance", 1e6)
+
+    if name in ("perspective", "realistic"):
+        if name == "realistic":
+            Warning("realistic camera approximated by thin-lens perspective model")
+            # aperturediameter in mm; focusdistance in meters
+            lens_radius = params.find_one_float("aperturediameter", 1.0) / 1000.0 / 2.0
+            focal = params.find_one_float("focusdistance", 10.0)
+            fov = 45.0
+        else:
+            fov = params.find_one_float("fov", 90.0)
+            halffov = params.find_one_float("halffov", -1.0)
+            if halffov > 0:
+                fov = 2.0 * halffov
+        screen = _screen_window(aspect, params)
+        cam_to_screen = xf.perspective(fov, 1e-2, 1000.0)
+        ctype = CAM_PERSPECTIVE
+    elif name == "orthographic":
+        screen = _screen_window(aspect, params)
+        cam_to_screen = xf.orthographic(0.0, 1.0)
+        ctype = CAM_ORTHOGRAPHIC
+    elif name == "environment":
+        screen = [-1.0, 1.0, -1.0, 1.0]
+        cam_to_screen = xf.Transform()
+        ctype = CAM_ENVIRONMENT
+    else:
+        Warning(f'Camera "{name}" unknown; using "perspective".')
+        return make_camera("perspective", params, cam_to_world, full_res, shutter)
+
+    x0, x1, y0, y1 = screen
+    screen_to_raster = (
+        xf.scale(res_x, res_y, 1.0)
+        * xf.scale(1.0 / (x1 - x0), 1.0 / (y0 - y1), 1.0)
+        * xf.translate([-x0, -y1, 0.0])
+    )
+    raster_to_screen = screen_to_raster.inverse()
+    raster_to_camera = cam_to_screen.inverse() * raster_to_screen
+
+    return CompiledCamera(
+        cam_type=ctype,
+        raster_to_camera=jnp.asarray(raster_to_camera.m, jnp.float32),
+        camera_to_world=jnp.asarray(cam_to_world.m, jnp.float32),
+        lens_radius=jnp.float32(lens_radius),
+        focal_distance=jnp.float32(focal),
+        shutter_open=shutter[0],
+        shutter_close=shutter[1],
+        full_res=(res_x, res_y),
+    )
+
+
+def _xform_point(m, p):
+    r = p @ m[:3, :3].T + m[:3, 3]
+    w = p @ m[3, :3].T + m[3, 3]
+    return r / jnp.where(w == 0.0, 1.0, w)[..., None]
+
+
+def _xform_vector(m, v):
+    return v @ m[:3, :3].T
+
+
+def generate_rays(cam: CompiledCamera, p_film, u_lens):
+    """Batched Camera::GenerateRay.
+
+    p_film: (...,2) raster-space sample points; u_lens: (...,2) in [0,1).
+    Returns (o, d, weight): world-space origins/directions + ray weight."""
+    p_raster = jnp.concatenate([p_film, jnp.zeros_like(p_film[..., :1])], axis=-1)
+    p_cam = _xform_point(cam.raster_to_camera, p_raster)
+
+    if cam.cam_type == CAM_PERSPECTIVE:
+        o = jnp.zeros_like(p_cam)
+        d = normalize(p_cam)
+    elif cam.cam_type == CAM_ORTHOGRAPHIC:
+        o = p_cam
+        d = jnp.broadcast_to(jnp.asarray([0.0, 0.0, 1.0], jnp.float32), p_cam.shape)
+    else:  # environment: lat-long over the full sphere (pbrt environment.cpp)
+        x, y = p_film[..., 0], p_film[..., 1]
+        theta = jnp.pi * y / cam.full_res[1]
+        phi = 2.0 * jnp.pi * x / cam.full_res[0]
+        d = jnp.stack(
+            [jnp.sin(theta) * jnp.cos(phi), jnp.cos(theta), jnp.sin(theta) * jnp.sin(phi)],
+            axis=-1,
+        )
+        o = jnp.zeros_like(d)
+
+    if cam.cam_type != CAM_ENVIRONMENT:
+        # thin-lens depth of field (ProjectiveCamera lens code)
+        def with_lens(o, d):
+            lx, ly = concentric_sample_disk(u_lens[..., 0], u_lens[..., 1])
+            p_lens = cam.lens_radius * jnp.stack([lx, ly], axis=-1)
+            ft = cam.focal_distance / jnp.where(d[..., 2] == 0.0, 1.0, d[..., 2])
+            p_focus = o + ft[..., None] * d
+            o_new = jnp.concatenate([p_lens, jnp.zeros_like(p_lens[..., :1])], axis=-1)
+            # orthographic keeps its z origin
+            o_new = o_new + o * jnp.asarray([0.0, 0.0, 1.0], jnp.float32)
+            d_new = normalize(p_focus - o_new)
+            return o_new, d_new
+
+        o_l, d_l = with_lens(o, d)
+        use_lens = cam.lens_radius > 0.0
+        o = jnp.where(use_lens, o_l, o)
+        d = jnp.where(use_lens, d_l, d)
+
+    o_w = _xform_point(cam.camera_to_world, o)
+    d_w = normalize(_xform_vector(cam.camera_to_world, d))
+    weight = jnp.ones(p_film.shape[:-1], jnp.float32)
+    return o_w, d_w, weight

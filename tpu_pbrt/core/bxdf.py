@@ -1,0 +1,478 @@
+"""Device-side BSDF evaluation and sampling (tagged-union dispatch).
+
+Capability match for pbrt-v3 src/core/reflection.{h,cpp} and
+src/core/microfacet.{h,cpp}:
+- Fresnel{Dielectric,Conductor,NoOp}
+- LambertianReflection/Transmission, OrenNayar
+- SpecularReflection/Transmission, FresnelSpecular
+- MicrofacetReflection (TrowbridgeReitz/GGX with visible-normal sampling)
+- FresnelBlend (substrate)
+and for the per-material BxDF assembly in src/materials/*::
+ComputeScatteringFunctions (matte/plastic/metal/glass/mirror/uber/
+substrate/translucent/disney lowered to lobe combinations).
+
+TPU-first design: instead of arena-allocated BxDF object stacks with
+virtual dispatch, every ray carries its gathered material parameters
+(SoA row) and the whole batch evaluates a fixed set of lobe formulas under
+masks — a diffuse lobe and a glossy/specular lobe per material, combined
+with pbrt's matching-lobe pdf averaging. All directions are in the local
+shading frame (z = shading normal). Radiance-mode transport (eta^2 scaling
+on specular transmission) matches pbrt's TransportMode::Radiance.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from tpu_pbrt.core.vecmath import (
+    abs_cos_theta,
+    cos2_theta,
+    cos_phi,
+    cos_theta,
+    same_hemisphere,
+    sin2_theta,
+    sin_phi,
+    tan2_theta,
+    tan_theta,
+)
+from tpu_pbrt.core.sampling import (
+    concentric_sample_disk,
+    cosine_hemisphere_pdf,
+    cosine_sample_hemisphere,
+)
+from tpu_pbrt.scene.compiler import (
+    MAT_DISNEY,
+    MAT_GLASS,
+    MAT_MATTE,
+    MAT_METAL,
+    MAT_MIRROR,
+    MAT_NONE,
+    MAT_PLASTIC,
+    MAT_SUBSTRATE,
+    MAT_TRANSLUCENT,
+    MAT_UBER,
+    MAT_FOURIER,
+    MAT_HAIR,
+    MAT_SUBSURFACE,
+)
+
+_INV_PI = 1.0 / jnp.pi
+
+
+# -------------------------------------------------------------------------
+# Fresnel (reflection.cpp FrDielectric / FrConductor)
+# -------------------------------------------------------------------------
+
+def fresnel_dielectric(cos_i, eta_i, eta_t):
+    """Unpolarized dielectric Fresnel; handles entering/exiting by sign."""
+    cos_i = jnp.clip(cos_i, -1.0, 1.0)
+    entering = cos_i > 0.0
+    ei = jnp.where(entering, eta_i, eta_t)
+    et = jnp.where(entering, eta_t, eta_i)
+    ci = jnp.abs(cos_i)
+    sin_t = ei / et * jnp.sqrt(jnp.maximum(0.0, 1.0 - ci * ci))
+    tir = sin_t >= 1.0
+    ct = jnp.sqrt(jnp.maximum(0.0, 1.0 - sin_t * sin_t))
+    r_parl = (et * ci - ei * ct) / jnp.maximum(et * ci + ei * ct, 1e-20)
+    r_perp = (ei * ci - et * ct) / jnp.maximum(ei * ci + et * ct, 1e-20)
+    fr = 0.5 * (r_parl * r_parl + r_perp * r_perp)
+    return jnp.where(tir, 1.0, fr)
+
+
+def fresnel_conductor(cos_i, eta, k):
+    """reflection.cpp FrConductor (per-channel; eta,k (...,3))."""
+    ci = jnp.clip(jnp.abs(cos_i), 0.0, 1.0)[..., None]
+    c2 = ci * ci
+    s2 = 1.0 - c2
+    e2 = eta * eta
+    k2 = k * k
+    t0 = e2 - k2 - s2
+    a2b2 = jnp.sqrt(jnp.maximum(t0 * t0 + 4.0 * e2 * k2, 0.0))
+    t1 = a2b2 + c2
+    a = jnp.sqrt(jnp.maximum(0.5 * (a2b2 + t0), 0.0))
+    t2 = 2.0 * a * ci
+    rs = (t1 - t2) / jnp.maximum(t1 + t2, 1e-20)
+    t3 = c2 * a2b2 + s2 * s2
+    t4 = t2 * s2
+    rp = rs * (t3 - t4) / jnp.maximum(t3 + t4, 1e-20)
+    return 0.5 * (rp + rs)
+
+
+# -------------------------------------------------------------------------
+# Trowbridge-Reitz / GGX microfacet distribution (microfacet.cpp)
+# -------------------------------------------------------------------------
+
+def tr_roughness_to_alpha(rough):
+    """TrowbridgeReitzDistribution::RoughnessToAlpha."""
+    rough = jnp.maximum(rough, 1e-3)
+    x = jnp.log(rough)
+    return (
+        1.62142
+        + 0.819955 * x
+        + 0.1734 * x * x
+        + 0.0171201 * x * x * x
+        + 0.000640711 * x * x * x * x
+    )
+
+
+def tr_d(wh, ax, ay):
+    t2 = tan2_theta(wh)
+    c4 = cos2_theta(wh) ** 2
+    e = (cos_phi(wh) ** 2 / jnp.maximum(ax * ax, 1e-12) + sin_phi(wh) ** 2 / jnp.maximum(ay * ay, 1e-12)) * t2
+    d = 1.0 / (jnp.pi * ax * ay * c4 * (1.0 + e) ** 2)
+    return jnp.where(jnp.isfinite(t2) & (c4 > 1e-16), d, 0.0)
+
+
+def tr_lambda(w, ax, ay):
+    abs_tan = jnp.abs(tan_theta(w))
+    alpha = jnp.sqrt(cos_phi(w) ** 2 * ax * ax + sin_phi(w) ** 2 * ay * ay)
+    a2t2 = (alpha * abs_tan) ** 2
+    lam = (-1.0 + jnp.sqrt(1.0 + a2t2)) / 2.0
+    return jnp.where(jnp.isfinite(abs_tan), lam, 0.0)
+
+
+def tr_g(wo, wi, ax, ay):
+    return 1.0 / (1.0 + tr_lambda(wo, ax, ay) + tr_lambda(wi, ax, ay))
+
+
+def tr_g1(w, ax, ay):
+    return 1.0 / (1.0 + tr_lambda(w, ax, ay))
+
+
+def _tr_sample11(cos_t, u1, u2):
+    """TrowbridgeReitzSample11: slopes for visible-normal sampling."""
+    # special case: normal incidence
+    sin_t = jnp.sqrt(jnp.maximum(0.0, 1.0 - cos_t * cos_t))
+    tan_t = sin_t / jnp.maximum(cos_t, 1e-7)
+    a = 1.0 / jnp.maximum(tan_t, 1e-12)
+    g1 = 2.0 / (1.0 + jnp.sqrt(1.0 + 1.0 / jnp.maximum(a * a, 1e-20)))
+
+    A = 2.0 * u1 / jnp.maximum(g1, 1e-12) - 1.0
+    A = jnp.clip(A, -1.0 + 1e-6, 1.0 - 1e-6)
+    tmp = jnp.minimum(1.0 / jnp.maximum(A * A - 1.0, 1e-12), 1e10)
+    tmp = jnp.where(A * A - 1.0 < 0, -tmp, tmp)  # keep sign behavior sane
+    B = tan_t
+    D = jnp.sqrt(jnp.maximum(B * B * tmp * tmp - (A * A - B * B) * tmp, 0.0))
+    slope_x_1 = B * tmp - D
+    slope_x_2 = B * tmp + D
+    slope_x = jnp.where((A < 0) | (slope_x_2 > 1.0 / jnp.maximum(tan_t, 1e-12)), slope_x_1, slope_x_2)
+
+    S = jnp.where(u2 > 0.5, 1.0, -1.0)
+    u2r = jnp.where(u2 > 0.5, 2.0 * (u2 - 0.5), 2.0 * (0.5 - u2))
+    z = (u2r * (u2r * (u2r * 0.27385 - 0.73369) + 0.46341)) / (
+        u2r * (u2r * (u2r * 0.093073 + 0.309420) - 1.000000) + 0.597999
+    )
+    slope_y = S * z * jnp.sqrt(1.0 + slope_x * slope_x)
+
+    # normal incidence fallback
+    r = jnp.sqrt(jnp.maximum(u1 / jnp.maximum(1.0 - u1, 1e-12), 0.0))
+    phi = 6.28318530718 * u2
+    ni = cos_t > 0.9999
+    slope_x = jnp.where(ni, r * jnp.cos(phi), slope_x)
+    slope_y = jnp.where(ni, r * jnp.sin(phi), slope_y)
+    return slope_x, slope_y
+
+
+def tr_sample_wh(wo, u1, u2, ax, ay):
+    """Visible-normal sampling (TrowbridgeReitzDistribution::Sample_wh)."""
+    flip = cos_theta(wo) < 0.0
+    wo_f = jnp.where(flip[..., None], -wo, wo)
+    # stretch
+    wi_s = jnp.stack([ax * wo_f[..., 0], ay * wo_f[..., 1], wo_f[..., 2]], axis=-1)
+    ln = jnp.sqrt(jnp.sum(wi_s * wi_s, axis=-1))
+    wi_s = wi_s / jnp.maximum(ln[..., None], 1e-20)
+    ct = jnp.clip(wi_s[..., 2], -1.0, 1.0)
+    s_len = jnp.sqrt(jnp.maximum(0.0, 1.0 - ct * ct))
+    cphi = jnp.where(s_len < 1e-7, 1.0, wi_s[..., 0] / jnp.maximum(s_len, 1e-12))
+    sphi = jnp.where(s_len < 1e-7, 0.0, wi_s[..., 1] / jnp.maximum(s_len, 1e-12))
+    sx, sy = _tr_sample11(ct, u1, u2)
+    # rotate
+    tmp = cphi * sx - sphi * sy
+    sy = sphi * sx + cphi * sy
+    sx = tmp
+    # unstretch
+    sx = sx * ax
+    sy = sy * ay
+    wh = jnp.stack([-sx, -sy, jnp.ones_like(sx)], axis=-1)
+    wh = wh / jnp.sqrt(jnp.sum(wh * wh, axis=-1))[..., None]
+    return jnp.where(flip[..., None], -wh, wh)
+
+
+def tr_pdf(wo, wh, ax, ay):
+    """pdf of wh under visible-normal sampling."""
+    return (
+        tr_d(wh, ax, ay)
+        * tr_g1(wo, ax, ay)
+        * jnp.abs(jnp.sum(wo * wh, axis=-1))
+        / jnp.maximum(abs_cos_theta(wo), 1e-12)
+    )
+
+
+# -------------------------------------------------------------------------
+# Material parameter gather
+# -------------------------------------------------------------------------
+
+class MatParams(NamedTuple):
+    mtype: jnp.ndarray  # (R,)
+    kd: jnp.ndarray  # (R,3)
+    ks: jnp.ndarray
+    kr: jnp.ndarray
+    kt: jnp.ndarray
+    eta: jnp.ndarray  # (R,3)
+    k: jnp.ndarray
+    ax: jnp.ndarray  # (R,) GGX alphas (post-remap)
+    ay: jnp.ndarray
+    sigma: jnp.ndarray  # oren-nayar sigma (degrees) / disney metallic
+    opacity: jnp.ndarray
+
+
+def gather_mat(mat: dict, mid) -> MatParams:
+    remap = mat["remap"][mid]
+    ru = mat["rough_u"][mid]
+    rv = mat["rough_v"][mid]
+    ax = jnp.where(remap > 0, tr_roughness_to_alpha(ru), jnp.maximum(ru, 1e-3))
+    ay = jnp.where(remap > 0, tr_roughness_to_alpha(rv), jnp.maximum(rv, 1e-3))
+    return MatParams(
+        mtype=mat["type"][mid],
+        kd=mat["kd"][mid],
+        ks=mat["ks"][mid],
+        kr=mat["kr"][mid],
+        kt=mat["kt"][mid],
+        eta=mat["eta"][mid],
+        k=mat["k"][mid],
+        ax=ax,
+        ay=ay,
+        sigma=mat["sigma"][mid],
+        opacity=mat["opacity"][mid],
+    )
+
+
+def _lobe_flags(mp: MatParams):
+    """(has_diffuse, has_glossy, is_specular_lobe, has_transmission)."""
+    t = mp.mtype
+    diffuse = (
+        (t == MAT_MATTE)
+        | (t == MAT_PLASTIC)
+        | (t == MAT_UBER)
+        | (t == MAT_TRANSLUCENT)
+        | (t == MAT_DISNEY)
+        | (t == MAT_HAIR)
+        | (t == MAT_FOURIER)
+        | (t == MAT_SUBSURFACE)
+    )
+    glossy = (
+        (t == MAT_PLASTIC) | (t == MAT_METAL) | (t == MAT_UBER) | (t == MAT_SUBSTRATE) | (t == MAT_DISNEY)
+    )
+    specular = (t == MAT_GLASS) | (t == MAT_MIRROR)
+    return diffuse, glossy, specular
+
+
+# -------------------------------------------------------------------------
+# Lobe formulas (batched, local frame)
+# -------------------------------------------------------------------------
+
+def _diffuse_f(mp: MatParams, wo, wi):
+    """Lambertian or Oren-Nayar by sigma; reflection hemisphere only."""
+    refl = same_hemisphere(wo, wi)
+    sigma = jnp.radians(mp.sigma)
+    s2 = sigma * sigma
+    a = 1.0 - s2 / (2.0 * (s2 + 0.33))
+    b = 0.45 * s2 / (s2 + 0.09)
+    sin_to = jnp.sqrt(sin2_theta(wo))
+    sin_ti = jnp.sqrt(sin2_theta(wi))
+    # max(0, cos(phi_i - phi_o))
+    cos_dphi = cos_phi(wi) * cos_phi(wo) + sin_phi(wi) * sin_phi(wo)
+    max_cos = jnp.maximum(0.0, cos_dphi)
+    has_sin = (sin_to > 1e-4) & (sin_ti > 1e-4)
+    max_cos = jnp.where(has_sin, max_cos, 0.0)
+    abs_ci = abs_cos_theta(wi)
+    abs_co = abs_cos_theta(wo)
+    sin_alpha = jnp.where(abs_ci > abs_co, sin_to, sin_ti)
+    tan_beta = jnp.where(
+        abs_ci > abs_co,
+        sin_ti / jnp.maximum(abs_ci, 1e-7),
+        sin_to / jnp.maximum(abs_co, 1e-7),
+    )
+    on = a + b * max_cos * sin_alpha * tan_beta
+    is_on = mp.sigma > 0.0
+    base = jnp.where(is_on, on, 1.0)
+    # translucent diffuse transmission: kd*kt on the opposite hemisphere
+    trans_scale = jnp.where(
+        (mp.mtype == MAT_TRANSLUCENT)[..., None], mp.kt, jnp.zeros_like(mp.kt)
+    )
+    refl_scale = jnp.where(
+        (mp.mtype == MAT_TRANSLUCENT)[..., None], mp.kr, jnp.ones_like(mp.kr)
+    )
+    f_refl = mp.kd * (_INV_PI * base)[..., None] * refl_scale
+    f_trans = mp.kd * _INV_PI * trans_scale
+    return jnp.where(refl[..., None], f_refl, f_trans)
+
+
+def _diffuse_pdf(mp: MatParams, wo, wi):
+    refl = same_hemisphere(wo, wi)
+    pdf_r = cosine_hemisphere_pdf(abs_cos_theta(wi))
+    is_transl = mp.mtype == MAT_TRANSLUCENT
+    # translucent splits the cosine pdf across both hemispheres
+    return jnp.where(
+        refl, jnp.where(is_transl, 0.5 * pdf_r, pdf_r), jnp.where(is_transl, 0.5 * pdf_r, 0.0)
+    )
+
+
+def _glossy_f(mp: MatParams, wo, wi):
+    """Microfacet reflection lobe (or FresnelBlend for substrate)."""
+    refl = same_hemisphere(wo, wi)
+    wh = wi + wo
+    wh_len = jnp.sqrt(jnp.sum(wh * wh, axis=-1))
+    valid = refl & (wh_len > 1e-12) & (abs_cos_theta(wi) > 1e-7) & (abs_cos_theta(wo) > 1e-7)
+    wh = wh / jnp.maximum(wh_len[..., None], 1e-20)
+    d = tr_d(wh, mp.ax, mp.ay)
+    g = tr_g(wo, wi, mp.ax, mp.ay)
+    cos_wh = jnp.sum(wi * wh, axis=-1)
+    is_metal = mp.mtype == MAT_METAL
+    eta_s = mp.eta[..., 0]
+    f_cond = fresnel_conductor(cos_wh, mp.eta, mp.k)
+    f_diel = fresnel_dielectric(cos_wh, jnp.ones_like(eta_s), eta_s)[..., None]
+    F = jnp.where(is_metal[..., None], f_cond, f_diel)
+    scale = jnp.where(is_metal[..., None], jnp.ones_like(mp.ks), mp.ks)
+    denom = 4.0 * abs_cos_theta(wi) * abs_cos_theta(wo)
+    f_mf = scale * F * (d * g / jnp.maximum(denom, 1e-12))[..., None]
+
+    # FresnelBlend (substrate): Ashikhmin-Shirley diffuse+spec
+    is_sub = mp.mtype == MAT_SUBSTRATE
+    pow5 = lambda v: (v * v) * (v * v) * v  # noqa: E731
+    diff = (
+        (28.0 / (23.0 * jnp.pi))
+        * mp.kd
+        * (1.0 - mp.ks)
+        * (1.0 - pow5(1.0 - 0.5 * abs_cos_theta(wi)))[..., None]
+        * (1.0 - pow5(1.0 - 0.5 * abs_cos_theta(wo)))[..., None]
+    )
+    schlick = mp.ks + pow5(1.0 - cos_wh)[..., None] * (1.0 - mp.ks)
+    spec = (
+        d
+        / jnp.maximum(4.0 * jnp.abs(cos_wh) * jnp.maximum(abs_cos_theta(wi), abs_cos_theta(wo)), 1e-12)
+    )[..., None] * schlick
+    f_sub = diff + spec
+
+    f = jnp.where(is_sub[..., None], f_sub, f_mf)
+    return jnp.where(valid[..., None], f, 0.0)
+
+
+def _glossy_pdf(mp: MatParams, wo, wi):
+    refl = same_hemisphere(wo, wi)
+    wh = wi + wo
+    wh_len = jnp.sqrt(jnp.sum(wh * wh, axis=-1))
+    wh = wh / jnp.maximum(wh_len[..., None], 1e-20)
+    pdf_wh = tr_pdf(wo, wh, mp.ax, mp.ay)
+    pdf = pdf_wh / jnp.maximum(4.0 * jnp.sum(wo * wh, axis=-1), 1e-12)
+    is_sub = mp.mtype == MAT_SUBSTRATE
+    # FresnelBlend pdf: average of cosine and half-vector pdfs
+    pdf_sub = 0.5 * (cosine_hemisphere_pdf(abs_cos_theta(wi)) + pdf)
+    pdf = jnp.where(is_sub, pdf_sub, pdf)
+    return jnp.where(refl & (wh_len > 1e-12), pdf, 0.0)
+
+
+# -------------------------------------------------------------------------
+# Public API
+# -------------------------------------------------------------------------
+
+def bsdf_eval(mp: MatParams, wo, wi):
+    """f(wo,wi) and pdf for non-specular lobes (pbrt BSDF::f / BSDF::Pdf
+    with BSDF_ALL & ~SPECULAR: specular lobes contribute zero)."""
+    has_d, has_g, is_spec = _lobe_flags(mp)
+    f = jnp.zeros_like(mp.kd)
+    pdf = jnp.zeros_like(mp.ax)
+    fd = _diffuse_f(mp, wo, wi)
+    pd = _diffuse_pdf(mp, wo, wi)
+    fg = _glossy_f(mp, wo, wi)
+    pg = _glossy_pdf(mp, wo, wi)
+    f = jnp.where(has_d[..., None], fd, 0.0) + jnp.where(has_g[..., None], fg, 0.0)
+    n_lobes = has_d.astype(jnp.float32) + has_g.astype(jnp.float32)
+    pdf = (jnp.where(has_d, pd, 0.0) + jnp.where(has_g, pg, 0.0)) / jnp.maximum(n_lobes, 1.0)
+    dead = is_spec | (mp.mtype == MAT_NONE)
+    return jnp.where(dead[..., None], 0.0, f), jnp.where(dead, 0.0, pdf)
+
+
+class BSDFSample(NamedTuple):
+    wi: jnp.ndarray  # (R,3) local frame
+    f: jnp.ndarray  # (R,3)
+    pdf: jnp.ndarray  # (R,)
+    is_specular: jnp.ndarray  # (R,) bool
+    is_transmission: jnp.ndarray  # (R,) bool
+
+
+def bsdf_sample(mp: MatParams, wo, u_lobe, u1, u2) -> BSDFSample:
+    """BSDF::Sample_f over the batch. u_lobe picks among matching lobes
+    (pbrt's uniform component choice); u1,u2 drive the chosen lobe."""
+    has_d, has_g, is_spec = _lobe_flags(mp)
+    n_lobes = has_d.astype(jnp.int32) + has_g.astype(jnp.int32)
+    pick_g = has_g & ((~has_d) | (u_lobe * n_lobes.astype(jnp.float32) >= 1.0))
+
+    # --- diffuse candidate (cosine hemisphere) ---------------------------
+    wi_d = cosine_sample_hemisphere(u1, u2)
+    wi_d = jnp.where((cos_theta(wo) < 0.0)[..., None], wi_d * jnp.asarray([1.0, 1.0, -1.0]), wi_d)
+    # translucent: u_lobe also chooses hemisphere (reflect/transmit)
+    is_transl = mp.mtype == MAT_TRANSLUCENT
+    flip_t = is_transl & (u2 < 0.5)  # reuse u2 high bits is fine statistically
+    wi_d = jnp.where(flip_t[..., None], wi_d * jnp.asarray([1.0, 1.0, -1.0]), wi_d)
+
+    # --- glossy candidate (VNDF half-vector) -----------------------------
+    wh = tr_sample_wh(wo, u1, u2, mp.ax, mp.ay)
+    wi_g = -wo + 2.0 * jnp.sum(wo * wh, axis=-1)[..., None] * wh
+    # substrate: half the samples are cosine (FresnelBlend::Sample_f)
+    is_sub = mp.mtype == MAT_SUBSTRATE
+    use_cos = is_sub & (u_lobe < 0.5)
+    wi_g = jnp.where(use_cos[..., None], wi_d, wi_g)
+
+    wi = jnp.where(pick_g[..., None], wi_g, wi_d)
+
+    # --- combined f/pdf over matching non-specular lobes -----------------
+    f_ns, pdf_ns = bsdf_eval(mp, wo, wi)
+
+    # --- specular materials ---------------------------------------------
+    eta_s = mp.eta[..., 0]
+    ct_o = cos_theta(wo)
+    F = fresnel_dielectric(ct_o, jnp.ones_like(eta_s), eta_s)
+    is_glass = mp.mtype == MAT_GLASS
+    is_mirror = mp.mtype == MAT_MIRROR
+    # mirror: perfect reflection, FresnelNoOp
+    wi_mirror = jnp.stack([-wo[..., 0], -wo[..., 1], wo[..., 2]], axis=-1)
+    f_mirror = mp.kr / jnp.maximum(abs_cos_theta(wi_mirror), 1e-12)[..., None]
+    # glass: choose R/T by Fresnel using u_lobe
+    reflect_g = u_lobe < F
+    entering = ct_o > 0.0
+    ei = jnp.where(entering, 1.0, eta_s)
+    et = jnp.where(entering, eta_s, 1.0)
+    eta_rel = ei / et
+    # refract in local frame about +/- z
+    n_loc = jnp.stack(
+        [jnp.zeros_like(ct_o), jnp.zeros_like(ct_o), jnp.where(entering, 1.0, -1.0)], axis=-1
+    )
+    ci = jnp.abs(ct_o)
+    sin2_t = eta_rel * eta_rel * jnp.maximum(0.0, 1.0 - ci * ci)
+    ct_t = jnp.sqrt(jnp.maximum(0.0, 1.0 - sin2_t))
+    wi_refr = eta_rel[..., None] * -wo + (eta_rel * ci - ct_t)[..., None] * n_loc
+    f_refl_g = (F / jnp.maximum(abs_cos_theta(wi_mirror), 1e-12))[..., None] * mp.kr
+    # radiance transport: (ei/et)^2 factor
+    f_trans_g = (
+        ((1.0 - F) * (ei / et) ** 2 / jnp.maximum(jnp.abs(ct_t), 1e-12))[..., None] * mp.kt
+    )
+    wi_glass = jnp.where(reflect_g[..., None], wi_mirror, wi_refr)
+    f_glass = jnp.where(reflect_g[..., None], f_refl_g, f_trans_g)
+    pdf_glass = jnp.where(reflect_g, F, 1.0 - F)
+
+    wi = jnp.where(is_mirror[..., None], wi_mirror, wi)
+    wi = jnp.where(is_glass[..., None], wi_glass, wi)
+    f = jnp.where(is_mirror[..., None], f_mirror, f_ns)
+    f = jnp.where(is_glass[..., None], f_glass, f)
+    pdf = jnp.where(is_mirror, 1.0, pdf_ns)
+    pdf = jnp.where(is_glass, pdf_glass, pdf)
+
+    is_specular = is_glass | is_mirror
+    is_transmission = (is_glass & ~reflect_g) | (flip_t & ~pick_g)
+    dead = (mp.mtype == MAT_NONE) | (pdf <= 0.0)
+    f = jnp.where(dead[..., None], 0.0, f)
+    pdf = jnp.where(dead, 0.0, pdf)
+    return BSDFSample(wi, f, pdf, is_specular, is_transmission)

@@ -1,0 +1,385 @@
+"""Device-side sampling: counter-based RNG, warps, distributions, MIS.
+
+Capability match for pbrt-v3:
+- src/core/rng.h RNG (PCG32): replaced TPU-first by a *stateless*
+  counter-based generator — every random number is a pure hash of
+  (pixel_index, sample_index, dimension) — so a wavefront of a million rays
+  draws its samples with no per-lane mutable state, renders are bit-exact
+  reproducible, and checkpoint/resume only needs the sample-range cursor
+  (SURVEY.md §5.4).
+- src/core/sampling.{h,cpp}: ConcentricSampleDisk, CosineSampleHemisphere,
+  UniformSample{Sphere,Hemisphere,Triangle,Cone}, Distribution1D/2D,
+  Balance/PowerHeuristic, StratifiedSample via index permutation.
+- src/core/lowdiscrepancy.h RadicalInverse / scrambled variants (the
+  Halton/(0,2)-sequence samplers in samplers/ build on these).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+ONE_MINUS_EPSILON = np.float32(0.99999994)
+
+
+# -------------------------------------------------------------------------
+# Stateless RNG. pcg-style integer hash over a mixed 32-bit counter.
+# -------------------------------------------------------------------------
+
+def _mix(h, v):
+    """One round of bob-jenkins-style avalanche combine (uint32)."""
+    h = (h ^ v) * jnp.uint32(0x9E3779B1)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    return h ^ (h >> 13)
+
+
+def hash_u32(*parts) -> jnp.ndarray:
+    """Hash any number of integer parts to uint32 (broadcasts)."""
+    h = jnp.uint32(0x2545F491)
+    for p in parts:
+        h = _mix(h, jnp.asarray(p).astype(jnp.uint32))
+    h = h * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
+def uniform_float(*parts) -> jnp.ndarray:
+    """U[0,1) from hashed parts; strictly < 1 (pbrt OneMinusEpsilon clamp)."""
+    u = hash_u32(*parts)
+    f = (u >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+    return jnp.minimum(f, ONE_MINUS_EPSILON)
+
+
+def uniform_2d(*parts):
+    """Two independent U[0,1) streams distinguished by a trailing salt."""
+    return uniform_float(*parts, 0x5B3C), uniform_float(*parts, 0xA7E9)
+
+
+# -------------------------------------------------------------------------
+# Warps (pbrt sampling.cpp)
+# -------------------------------------------------------------------------
+
+def concentric_sample_disk(u1, u2):
+    """Shirley–Chiu concentric map; returns (x, y)."""
+    ox = 2.0 * u1 - 1.0
+    oy = 2.0 * u2 - 1.0
+    degenerate = (ox == 0.0) & (oy == 0.0)
+    use_x = jnp.abs(ox) > jnp.abs(oy)
+    r = jnp.where(use_x, ox, oy)
+    theta = jnp.where(
+        use_x,
+        (jnp.pi / 4.0) * (oy / jnp.where(ox == 0.0, 1.0, ox)),
+        (jnp.pi / 2.0) - (jnp.pi / 4.0) * (ox / jnp.where(oy == 0.0, 1.0, oy)),
+    )
+    x = jnp.where(degenerate, 0.0, r * jnp.cos(theta))
+    y = jnp.where(degenerate, 0.0, r * jnp.sin(theta))
+    return x, y
+
+
+def cosine_sample_hemisphere(u1, u2):
+    """Malley's method; returns direction (...,3) in local frame, z up."""
+    x, y = concentric_sample_disk(u1, u2)
+    z = jnp.sqrt(jnp.maximum(0.0, 1.0 - x * x - y * y))
+    return jnp.stack([x, y, z], axis=-1)
+
+
+def cosine_hemisphere_pdf(cos_theta):
+    return cos_theta * (1.0 / jnp.pi)
+
+
+def uniform_sample_hemisphere(u1, u2):
+    z = u1
+    r = jnp.sqrt(jnp.maximum(0.0, 1.0 - z * z))
+    phi = 2.0 * jnp.pi * u2
+    return jnp.stack([r * jnp.cos(phi), r * jnp.sin(phi), z], axis=-1)
+
+
+UNIFORM_HEMISPHERE_PDF = 1.0 / (2.0 * np.pi)
+UNIFORM_SPHERE_PDF = 1.0 / (4.0 * np.pi)
+
+
+def uniform_sample_sphere(u1, u2):
+    z = 1.0 - 2.0 * u1
+    r = jnp.sqrt(jnp.maximum(0.0, 1.0 - z * z))
+    phi = 2.0 * jnp.pi * u2
+    return jnp.stack([r * jnp.cos(phi), r * jnp.sin(phi), z], axis=-1)
+
+
+def uniform_sample_triangle(u1, u2):
+    """Returns barycentrics (b0, b1) (sqrt warp)."""
+    su0 = jnp.sqrt(u1)
+    return 1.0 - su0, u2 * su0
+
+
+def uniform_sample_cone(u1, u2, cos_theta_max):
+    cos_theta = (1.0 - u1) + u1 * cos_theta_max
+    sin_theta = jnp.sqrt(jnp.maximum(0.0, 1.0 - cos_theta * cos_theta))
+    phi = 2.0 * jnp.pi * u2
+    return jnp.stack(
+        [sin_theta * jnp.cos(phi), sin_theta * jnp.sin(phi), cos_theta], axis=-1
+    )
+
+
+def uniform_cone_pdf(cos_theta_max):
+    return 1.0 / (2.0 * jnp.pi * jnp.maximum(1.0 - cos_theta_max, 1e-9))
+
+
+# -------------------------------------------------------------------------
+# MIS heuristics (pbrt sampling.h)
+# -------------------------------------------------------------------------
+
+def balance_heuristic(nf, f_pdf, ng, g_pdf):
+    return (nf * f_pdf) / jnp.maximum(nf * f_pdf + ng * g_pdf, 1e-20)
+
+
+def power_heuristic(nf, f_pdf, ng, g_pdf):
+    f = nf * f_pdf
+    g = ng * g_pdf
+    return (f * f) / jnp.maximum(f * f + g * g, 1e-20)
+
+
+# -------------------------------------------------------------------------
+# Stratification on a counter-based stream. A wavefront renderer cannot
+# carry pbrt's per-pixel sample arrays, so stratified dimensions are formed
+# directly from the sample index: for spp = sx*sy, sample s of pixel p gets
+# cell perm_p(s) of an sx×sy grid, jittered. perm_p is a per-pixel
+# Feistel-style permutation so cross-dimension correlation is broken
+# (pbrt's Shuffle equivalent, but stateless).
+# -------------------------------------------------------------------------
+
+def permutation_element(i, n, seed):
+    """Stateless random permutation of [0,n): Kensler's hash permutation
+    (Correlated Multi-Jittered Sampling, also pbrt-v4 PermutationElement) —
+    an invertible mix cycle-walked on the next power of two. The unbounded
+    do-while becomes 16 fixed masked rounds (miss probability < 2^-16 per
+    element; each round rejects with p < 1/2)."""
+    n = jnp.asarray(n, jnp.uint32)
+    i = jnp.asarray(i, jnp.uint32)
+    p = jnp.asarray(seed, jnp.uint32)
+    w = n - 1
+    w = w | (w >> 1)
+    w = w | (w >> 2)
+    w = w | (w >> 4)
+    w = w | (w >> 8)
+    w = w | (w >> 16)
+
+    def mix(i):
+        i = i ^ p
+        i = i * jnp.uint32(0xE170893D)
+        i = i ^ (p >> 16)
+        i = i ^ ((i & w) >> 4)
+        i = i ^ (p >> 8)
+        i = i * jnp.uint32(0x0929EB3F)
+        i = i ^ (p >> 23)
+        i = i ^ ((i & w) >> 1)
+        i = i * (jnp.uint32(1) | (p >> 27))
+        i = i * jnp.uint32(0x6935FA69)
+        i = i ^ ((i & w) >> 11)
+        i = i * jnp.uint32(0x74DCCA23)
+        i = i ^ (p >> 2)
+        i = i * jnp.uint32(0x9E501CC3)
+        i = i ^ ((i & w) >> 2)
+        i = i * jnp.uint32(0xC860A3DF)
+        i = i & w
+        return i ^ (i >> 5)
+
+    y = mix(i)
+    for _ in range(15):
+        y = jnp.where(y >= n, mix(y), y)
+    return (jnp.minimum(y, n - 1) + p) % n
+
+
+def stratified_1d(sample_index, n_strata, *key_parts):
+    """Jittered stratified sample: cell = perm(sample_index), jitter inside."""
+    seed = hash_u32(*key_parts, 0x517A)
+    cell = permutation_element(sample_index, n_strata, seed).astype(jnp.float32)
+    u = uniform_float(*key_parts, 0x11D7)
+    return jnp.minimum((cell + u) / n_strata, ONE_MINUS_EPSILON)
+
+
+def stratified_2d(sample_index, sx, sy, *key_parts):
+    """Jittered 2D stratification over an sx×sy grid."""
+    seed = hash_u32(*key_parts, 0x2F83)
+    cell = permutation_element(sample_index, sx * sy, seed)
+    cx = (cell % jnp.uint32(sx)).astype(jnp.float32)
+    cy = (cell // jnp.uint32(sx)).astype(jnp.float32)
+    u1 = uniform_float(*key_parts, 0x9E01)
+    u2 = uniform_float(*key_parts, 0xC6A3)
+    return (
+        jnp.minimum((cx + u1) / sx, ONE_MINUS_EPSILON),
+        jnp.minimum((cy + u2) / sy, ONE_MINUS_EPSILON),
+    )
+
+
+# -------------------------------------------------------------------------
+# Radical inverse / scrambling (pbrt lowdiscrepancy.h) — bases 2 and 3
+# device-side; arbitrary-base host-side for Halton tables.
+# -------------------------------------------------------------------------
+
+def reverse_bits_32(n):
+    n = jnp.asarray(n, jnp.uint32)
+    n = (n << 16) | (n >> 16)
+    n = ((n & jnp.uint32(0x00FF00FF)) << 8) | ((n & jnp.uint32(0xFF00FF00)) >> 8)
+    n = ((n & jnp.uint32(0x0F0F0F0F)) << 4) | ((n & jnp.uint32(0xF0F0F0F0)) >> 4)
+    n = ((n & jnp.uint32(0x33333333)) << 2) | ((n & jnp.uint32(0xCCCCCCCC)) >> 2)
+    n = ((n & jnp.uint32(0x55555555)) << 1) | ((n & jnp.uint32(0xAAAAAAAA)) >> 1)
+    return n
+
+
+def radical_inverse_base2(n, scramble=0):
+    """Van der Corput, with optional XOR scramble (uint32)."""
+    bits = reverse_bits_32(n) ^ jnp.asarray(scramble, jnp.uint32)
+    return jnp.minimum(
+        bits.astype(jnp.float32) * jnp.float32(2.3283064365386963e-10), ONE_MINUS_EPSILON
+    )
+
+
+def sobol_2d(n, scramble_x=0, scramble_y=0):
+    """First two dimensions of the Sobol' sequence ((0,2)-sequence), as used
+    by pbrt's ZeroTwoSequenceSampler (gray-code matrices for dim 2)."""
+    x = reverse_bits_32(n) ^ jnp.asarray(scramble_x, jnp.uint32)
+
+    # dimension 2: Sobol' direction numbers for the second dimension
+    v = jnp.uint32(1 << 31)
+    n = jnp.asarray(n, jnp.uint32)
+    y = jnp.zeros_like(n)
+    for i in range(32):
+        y = jnp.where((n >> i) & 1, y ^ v, y)
+        v = v ^ (v >> 1)
+    y = y ^ jnp.asarray(scramble_y, jnp.uint32)
+    to_f = jnp.float32(2.3283064365386963e-10)
+    return (
+        jnp.minimum(x.astype(jnp.float32) * to_f, ONE_MINUS_EPSILON),
+        jnp.minimum(y.astype(jnp.float32) * to_f, ONE_MINUS_EPSILON),
+    )
+
+
+# -------------------------------------------------------------------------
+# Distribution1D / Distribution2D (pbrt sampling.h) — piecewise-constant
+# CDF importance sampling. Build host-side (numpy), sample device-side.
+# -------------------------------------------------------------------------
+
+class Distribution1D(NamedTuple):
+    """func: (N,), cdf: (N+1,), integral: scalar — all device arrays."""
+
+    func: jnp.ndarray
+    cdf: jnp.ndarray
+    func_int: jnp.ndarray
+
+    @staticmethod
+    def build(f) -> "Distribution1D":
+        f = np.asarray(f, dtype=np.float64)
+        n = len(f)
+        cdf = np.zeros(n + 1)
+        cdf[1:] = np.cumsum(f) / n
+        func_int = cdf[-1]
+        if func_int == 0:
+            cdf[1:] = np.arange(1, n + 1) / n
+        else:
+            cdf[1:] /= func_int
+        return Distribution1D(
+            jnp.asarray(f, jnp.float32), jnp.asarray(cdf, jnp.float32), jnp.float32(func_int)
+        )
+
+    @property
+    def count(self):
+        return self.func.shape[0]
+
+    def sample_continuous(self, u):
+        """Returns (x in [0,1), pdf, offset)."""
+        offset = jnp.clip(
+            jnp.searchsorted(self.cdf, u, side="right") - 1, 0, self.count - 1
+        )
+        c0 = self.cdf[offset]
+        c1 = self.cdf[offset + 1]
+        du = jnp.where(c1 > c0, (u - c0) / jnp.maximum(c1 - c0, 1e-20), 0.0)
+        pdf = jnp.where(
+            self.func_int > 0, self.func[offset] / jnp.maximum(self.func_int, 1e-20), 0.0
+        )
+        x = (offset.astype(jnp.float32) + du) / self.count
+        return x, pdf, offset
+
+    def sample_discrete(self, u):
+        """Returns (offset, pmf)."""
+        offset = jnp.clip(
+            jnp.searchsorted(self.cdf, u, side="right") - 1, 0, self.count - 1
+        )
+        pmf = jnp.where(
+            self.func_int > 0,
+            self.func[offset] / jnp.maximum(self.func_int * self.count, 1e-20),
+            0.0,
+        )
+        return offset, pmf
+
+    def discrete_pdf(self, index):
+        return self.func[index] / jnp.maximum(self.func_int * self.count, 1e-20)
+
+
+class Distribution2D(NamedTuple):
+    """Conditional rows + marginal over rows, flattened to fixed arrays.
+
+    cond_func/cond_cdf: (H, W)/(H, W+1); marg over row integrals."""
+
+    cond_func: jnp.ndarray
+    cond_cdf: jnp.ndarray
+    cond_int: jnp.ndarray  # (H,)
+    marg_func: jnp.ndarray  # (H,)
+    marg_cdf: jnp.ndarray  # (H+1,)
+    marg_int: jnp.ndarray  # scalar
+
+    @staticmethod
+    def build(f) -> "Distribution2D":
+        f = np.asarray(f, dtype=np.float64)
+        h, w = f.shape
+        cond_cdf = np.zeros((h, w + 1))
+        cond_cdf[:, 1:] = np.cumsum(f, axis=1) / w
+        cond_int = cond_cdf[:, -1].copy()
+        safe = np.where(cond_int == 0, 1.0, cond_int)
+        cond_cdf[:, 1:] = np.where(
+            cond_int[:, None] == 0,
+            np.arange(1, w + 1)[None, :] / w,
+            cond_cdf[:, 1:] / safe[:, None],
+        )
+        marg = Distribution1D.build(cond_int)
+        return Distribution2D(
+            jnp.asarray(f, jnp.float32),
+            jnp.asarray(cond_cdf, jnp.float32),
+            jnp.asarray(cond_int, jnp.float32),
+            marg.func,
+            marg.cdf,
+            marg.func_int,
+        )
+
+    def sample_continuous(self, u1, u2):
+        """Returns ((u, v), pdf)."""
+        h, w = self.cond_func.shape
+        # marginal (rows)
+        row = jnp.clip(jnp.searchsorted(self.marg_cdf, u2, side="right") - 1, 0, h - 1)
+        mc0 = self.marg_cdf[row]
+        mc1 = self.marg_cdf[row + 1]
+        dv = jnp.where(mc1 > mc0, (u2 - mc0) / jnp.maximum(mc1 - mc0, 1e-20), 0.0)
+        pdf_v = jnp.where(
+            self.marg_int > 0, self.marg_func[row] / jnp.maximum(self.marg_int, 1e-20), 0.0
+        )
+        v = (row.astype(jnp.float32) + dv) / h
+        # conditional (cols within row) — count-based search so it batches
+        cdf_row = self.cond_cdf[row]  # (..., W+1)
+        u1e = jnp.asarray(u1)[..., None]
+        col = jnp.clip(jnp.sum(cdf_row <= u1e, axis=-1) - 1, 0, w - 1)
+        cc0 = jnp.take_along_axis(cdf_row, col[..., None], axis=-1)[..., 0]
+        cc1 = jnp.take_along_axis(cdf_row, col[..., None] + 1, axis=-1)[..., 0]
+        du = jnp.where(cc1 > cc0, (u1 - cc0) / jnp.maximum(cc1 - cc0, 1e-20), 0.0)
+        ci = self.cond_int[row]
+        fval = jnp.take_along_axis(self.cond_func[row], col[..., None], axis=-1)[..., 0]
+        pdf_u = jnp.where(ci > 0, fval / jnp.maximum(ci, 1e-20), 0.0)
+        uu = (col.astype(jnp.float32) + du) / w
+        return (uu, v), pdf_u * pdf_v
+
+    def pdf(self, u, v):
+        """Pdf of (u,v) in [0,1)^2 (pbrt Distribution2D::Pdf)."""
+        h, w = self.cond_func.shape
+        iu = jnp.clip((u * w).astype(jnp.int32), 0, w - 1)
+        iv = jnp.clip((v * h).astype(jnp.int32), 0, h - 1)
+        return self.cond_func[iv, iu] / jnp.maximum(self.marg_int, 1e-20)

@@ -43,11 +43,10 @@ def rgb_to_xyz(rgb) -> np.ndarray:
     return _RGB_TO_XYZ @ np.asarray(rgb, dtype=np.float64)
 
 
-def luminance(rgb) -> float:
-    rgb = np.asarray(rgb)
-    return float(0.212671 * rgb[..., 0] + 0.715160 * rgb[..., 1] + 0.072169 * rgb[..., 2]) if rgb.ndim == 1 else (
-        0.212671 * rgb[..., 0] + 0.715160 * rgb[..., 1] + 0.072169 * rgb[..., 2]
-    )
+def luminance(rgb):
+    """Rec.709 luminance (pbrt RGBSpectrum::y). Backend-agnostic: works on
+    numpy and traced jax arrays; returns an array of rgb's batch shape."""
+    return 0.212671 * rgb[..., 0] + 0.715160 * rgb[..., 1] + 0.072169 * rgb[..., 2]
 
 
 def _gauss(x, alpha, mu, s1, s2):

@@ -107,7 +107,8 @@ def reflect(wo, n):
 
 def refract(wi, n, eta):
     """pbrt Refract. Returns (refracted_dir, total_internal_reflection_mask).
-    eta = eta_i/eta_t; n on same side as wi."""
+    eta = eta_i/eta_t (scalar or batched); n on same side as wi."""
+    eta = jnp.asarray(eta)
     cos_theta_i = dot(n, wi)
     sin2_theta_i = jnp.maximum(0.0, 1.0 - cos_theta_i * cos_theta_i)
     sin2_theta_t = eta * eta * sin2_theta_i

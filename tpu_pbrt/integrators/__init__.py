@@ -1,0 +1,54 @@
+"""Integrator plugin registry.
+
+Capability match for pbrt-v3 api.cpp MakeIntegrator: the string-dispatched
+factory seam through which .pbrt scene files select the rendering
+algorithm. The TPU backend registers `tpupath` here (the north-star
+requirement: existing scenes switch integrators without modification);
+`path` itself is the same wavefront implementation, so both names run
+TPU-native.
+"""
+
+from __future__ import annotations
+
+from tpu_pbrt.utils.error import Warning
+
+_REGISTRY = {}
+
+
+def register_integrator(name: str, cls):
+    _REGISTRY[name] = cls
+
+
+def _optional(builtin, name, module, cls_name):
+    full = f"tpu_pbrt.integrators.{module}"
+    try:
+        mod = __import__(full, fromlist=[cls_name])
+        builtin.setdefault(name, getattr(mod, cls_name))
+    except ModuleNotFoundError as e:
+        if e.name != full:  # a broken dependency, not a missing plugin
+            raise
+
+
+def make_integrator(name: str, params, scene, options):
+    from tpu_pbrt.integrators.direct import DirectLightingIntegrator
+    from tpu_pbrt.integrators.path import PathIntegrator
+    from tpu_pbrt.integrators.whitted import WhittedIntegrator
+
+    builtin = {
+        "path": PathIntegrator,
+        "tpupath": PathIntegrator,
+        "directlighting": DirectLightingIntegrator,
+        "whitted": WhittedIntegrator,
+    }
+    builtin.update(_REGISTRY)
+    _optional(builtin, "volpath", "volpath", "VolPathIntegrator")
+    _optional(builtin, "bdpt", "bdpt", "BDPTIntegrator")
+    _optional(builtin, "sppm", "sppm", "SPPMIntegrator")
+    _optional(builtin, "mlt", "mlt", "MLTIntegrator")
+    _optional(builtin, "ao", "ao", "AOIntegrator")
+
+    cls = builtin.get(name)
+    if cls is None:
+        Warning(f'Integrator "{name}" unknown. Using "path".')
+        cls = builtin["path"]
+    return cls(params, scene, options)

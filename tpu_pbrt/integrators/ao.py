@@ -1,0 +1,63 @@
+"""AOIntegrator — ambient occlusion.
+
+Capability match for pbrt-v3 src/integrators/ao.{h,cpp} (present in later
+pbrt-v3; SURVEY.md §2c flags it "verify in fork"): cosine- or
+uniform-weighted hemisphere visibility with a max distance. One occlusion
+sample per camera sample (pixel samples average them, matching the
+wavefront sampler model)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tpu_pbrt.accel.traverse import bvh_intersect, bvh_intersect_p
+from tpu_pbrt.core.sampling import (
+    UNIFORM_HEMISPHERE_PDF,
+    cosine_hemisphere_pdf,
+    cosine_sample_hemisphere,
+    uniform_float,
+    uniform_sample_hemisphere,
+)
+from tpu_pbrt.core.vecmath import dot, offset_ray_origin, to_world
+from tpu_pbrt.integrators.common import (
+    DIM_BSDF_UV,
+    WavefrontIntegrator,
+    make_interaction,
+)
+
+
+class AOIntegrator(WavefrontIntegrator):
+    name = "ao"
+
+    def __init__(self, params, scene, options):
+        super().__init__(params, scene, options)
+        self.cos_sample = params.find_one_bool("cossample", True)
+        self.max_dist = params.find_one_float("maxdistance", float("inf"))
+
+    def li(self, dev, o, d, px, py, s):
+        hit = bvh_intersect(dev["bvh"], dev["tri_verts"], o, d, jnp.inf)
+        it = make_interaction(dev, hit, o, d)
+        nrays = jnp.ones(o.shape[:-1], jnp.int32)
+
+        u1 = uniform_float(px, py, s, DIM_BSDF_UV)
+        u2 = uniform_float(px, py, s, DIM_BSDF_UV + 100)
+        if self.cos_sample:
+            w_local = cosine_sample_hemisphere(u1, u2)
+            pdf = cosine_hemisphere_pdf(w_local[..., 2])
+        else:
+            w_local = uniform_sample_hemisphere(u1, u2)
+            pdf = jnp.full(u1.shape, UNIFORM_HEMISPHERE_PDF, jnp.float32)
+        # flip into the hemisphere facing the viewer (ao.cpp: -w if
+        # opposite n)
+        wi = to_world(w_local, it.ss, it.ts, it.ns)
+        flip = dot(wi, it.ns) * dot(it.wo, it.ns) < 0.0
+        wi = jnp.where(flip[..., None], -wi, wi)
+        o_sh = offset_ray_origin(it.p, it.ng, wi)
+        occluded = bvh_intersect_p(dev["bvh"], dev["tri_verts"], o_sh, wi, self.max_dist)
+        nrays = nrays + it.valid.astype(jnp.int32)
+        cos_w = jnp.abs(dot(wi, it.ns))
+        val = jnp.where(
+            it.valid & ~occluded & (pdf > 0), cos_w / jnp.maximum(pdf, 1e-20) / jnp.pi, 0.0
+        )
+        L = jnp.broadcast_to(val[..., None], val.shape + (3,))
+        return L, nrays

@@ -1,0 +1,396 @@
+"""Shared wavefront-integrator machinery.
+
+Capability match for pbrt-v3 src/core/integrator.{h,cpp}:
+- Integrator/SamplerIntegrator::Render — the tile loop. TPU-first redesign:
+  instead of ParallelFor2D over 16x16 tiles with per-thread FilmTiles, the
+  image x spp domain is a flat work index space, cut into fixed-size ray
+  batches (<= MAX_RAYS_PER_DISPATCH). Each batch runs one jitted
+  ray-gen -> Li -> film-scatter dispatch; film accumulation is associative
+  so "tiles" merge by addition. Tiling across devices (shard_map over the
+  work axis) is layered on in parallel/ (SURVEY.md §2f).
+- UniformSampleOneLight / EstimateDirect (MIS NEE) — estimate_direct here.
+- SurfaceInteraction construction (core/interaction.cpp): hit -> position,
+  geometric/shading normals, uv, material/light ids.
+
+Sampling convention: every random dimension is a pure function of
+(pixel_x, pixel_y, sample_index, dimension_salt) via the counter-based RNG,
+with the film dimension using a per-pixel-scrambled (0,2)-sequence — the
+wavefront equivalent of pbrt's per-pixel sampler streams.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_pbrt.accel.traverse import (
+    MAX_RAYS_PER_DISPATCH,
+    Hit,
+    bvh_intersect,
+    bvh_intersect_p,
+)
+from tpu_pbrt.cameras import generate_rays
+from tpu_pbrt.core import bxdf
+from tpu_pbrt.core import lights_dev as ld
+from tpu_pbrt.core.film import FilmState
+from tpu_pbrt.parallel.checkpoint import load_checkpoint, save_checkpoint
+from tpu_pbrt.core.sampling import hash_u32, power_heuristic, sobol_2d, uniform_float
+from tpu_pbrt.core.vecmath import (
+    coordinate_system,
+    cross,
+    dot,
+    face_forward,
+    normalize,
+    offset_ray_origin,
+    to_local,
+    to_world,
+)
+
+# dimension salts (one stream per logical sampler dimension; bounce-shifted)
+DIM_FILM_X = 0
+DIM_LENS = 2
+DIM_LIGHT_PICK = 4
+DIM_LIGHT_UV = 5
+DIM_BSDF_LOBE = 7
+DIM_BSDF_UV = 8
+DIM_RR = 10
+DIMS_PER_BOUNCE = 16
+
+
+@dataclass
+class RenderResult:
+    image: np.ndarray
+    film_state: Any
+    seconds: float
+    rays_traced: int
+    mray_per_sec: float
+    spp: int
+    stats: Dict[str, Any] = field(default_factory=dict)
+
+
+class Interaction:
+    """SoA surface interaction for a ray batch."""
+
+    __slots__ = ("p", "ng", "ns", "ss", "ts", "uv", "mat", "light", "wo", "valid")
+
+    def __init__(self, p, ng, ns, ss, ts, uv, mat, light, wo, valid):
+        self.p = p
+        self.ng = ng
+        self.ns = ns
+        self.ss = ss  # shading tangent
+        self.ts = ts  # shading bitangent
+        self.uv = uv
+        self.mat = mat
+        self.light = light
+        self.wo = wo
+        self.valid = valid
+
+
+def make_interaction(dev, hit: Hit, o, d) -> Interaction:
+    """Hit records -> surface interaction (interaction.cpp SurfaceInteraction
+    + triangle.cpp's normal/uv interpolation)."""
+    prim = jnp.maximum(hit.prim, 0)
+    tv = dev["tri_verts"][prim]
+    tn = dev["tri_normals"][prim]
+    tuv = dev["tri_uvs"][prim]
+    b0 = hit.b0
+    b1 = hit.b1
+    b2 = 1.0 - b0 - b1
+    p = b0[..., None] * tv[..., 0, :] + b1[..., None] * tv[..., 1, :] + b2[..., None] * tv[..., 2, :]
+    e1 = tv[..., 1, :] - tv[..., 0, :]
+    e2 = tv[..., 2, :] - tv[..., 0, :]
+    ng = normalize(cross(e1, e2))
+    ns = b0[..., None] * tn[..., 0, :] + b1[..., None] * tn[..., 1, :] + b2[..., None] * tn[..., 2, :]
+    ns_len = jnp.linalg.norm(ns, axis=-1, keepdims=True)
+    ns = jnp.where(ns_len > 1e-12, ns / jnp.maximum(ns_len, 1e-20), ng)
+    # orient geometric normal to the shading normal's hemisphere
+    ng = face_forward(ng, ns)
+    uv = b0[..., None] * tuv[..., 0, :] + b1[..., None] * tuv[..., 1, :] + b2[..., None] * tuv[..., 2, :]
+    ss, ts = coordinate_system(ns)
+    return Interaction(
+        p=p,
+        ng=ng,
+        ns=ns,
+        ss=ss,
+        ts=ts,
+        uv=uv,
+        mat=dev["tri_mat"][prim],
+        light=dev["tri_light"][prim],
+        wo=-d,
+        valid=hit.prim >= 0,
+    )
+
+
+def estimate_direct(dev, light_distr, it: Interaction, mp, px, py, s, bounce, light_idx=None, salt_extra=0):
+    """pbrt EstimateDirect with MIS, light-sampling half + BSDF-sampling
+    half. Traces one shadow ray and (for the BSDF half) one MIS ray.
+
+    light_idx None -> UniformSampleOneLight semantics (random light, pick
+    pmf folded into the pdf). light_idx (R,) -> EstimateDirect against that
+    specific light (UniformSampleAllLights loops this over every light).
+    Returns (R,3) direct radiance at the interaction."""
+    salt = bounce * DIMS_PER_BOUNCE + salt_extra
+
+    # ---- light-sampling half -------------------------------------------
+    u_pick = uniform_float(px, py, s, salt + DIM_LIGHT_PICK)
+    u1 = uniform_float(px, py, s, salt + DIM_LIGHT_UV)
+    u2 = uniform_float(px, py, s, salt + DIM_LIGHT_UV + 100)
+    if light_idx is None:
+        ls = ld.sample_one_light(dev, light_distr, it.p, u_pick, u1, u2)
+    else:
+        ls = ld.sample_light_rows(dev, light_idx, it.p, u1, u2)
+    wi_l = to_local(ls.wi, it.ss, it.ts, it.ns)
+    wo_l = to_local(it.wo, it.ss, it.ts, it.ns)
+    f, bsdf_pdf = bxdf.bsdf_eval(mp, wo_l, wi_l)
+    f = f * jnp.abs(dot(ls.wi, it.ns))[..., None]
+    do_light = it.valid & (ls.pdf > 0.0) & (jnp.max(f, axis=-1) > 0.0) & (
+        jnp.max(ls.li, axis=-1) > 0.0
+    )
+    # shadow ray
+    o_s = offset_ray_origin(it.p, it.ng, ls.wi)
+    occluded = bvh_intersect_p(
+        dev["bvh"], dev["tri_verts"], o_s, ls.wi, ls.dist * 0.999
+    )
+    vis = do_light & ~occluded
+    w_light = jnp.where(ls.is_delta, 1.0, power_heuristic(1.0, ls.pdf, 1.0, bsdf_pdf))
+    contrib_l = f * ls.li * (w_light / jnp.maximum(ls.pdf, 1e-20))[..., None]
+    L = jnp.where(vis[..., None], contrib_l, 0.0)
+
+    # ---- BSDF-sampling half (non-delta lights: area + infinite) ---------
+    ul = uniform_float(px, py, s, salt + DIM_BSDF_LOBE + 200)
+    ub1 = uniform_float(px, py, s, salt + DIM_BSDF_UV + 200)
+    ub2 = uniform_float(px, py, s, salt + DIM_BSDF_UV + 300)
+    bs = bxdf.bsdf_sample(mp, wo_l, ul, ub1, ub2)
+    wi_w = to_world(bs.wi, it.ss, it.ts, it.ns)
+    f_b = bs.f * jnp.abs(dot(wi_w, it.ns))[..., None]
+    do_b = (
+        it.valid
+        & ~bs.is_specular
+        & (bs.pdf > 0.0)
+        & (jnp.max(f_b, axis=-1) > 0.0)
+    )
+    o_b = offset_ray_origin(it.p, it.ng, wi_w)
+    hit_b = bvh_intersect(dev["bvh"], dev["tri_verts"], o_b, wi_w, jnp.inf)
+    hit_light = dev["tri_light"][jnp.maximum(hit_b.prim, 0)]
+    hit_emissive = (hit_b.prim >= 0) & (hit_light >= 0)
+    # emitted toward us?
+    if light_idx is not None:
+        # restricted to one light: only count hits on that light's triangle
+        hit_emissive = hit_emissive & (hit_light == light_idx)
+    it_b = make_interaction(dev, hit_b, o_b, wi_w)
+    le_b = ld.emitted_radiance(dev, jnp.where(hit_emissive, hit_light, -1), -wi_w, it_b.ng)
+    # pdf of light-sampling this direction (for MIS): pick pmf is included
+    # in the one-light case and excluded in the restricted case, matching
+    # the pdf convention of the light half above
+    lpdf_area = ld.emitted_pdf(
+        dev, None if light_idx is not None else light_distr, it.p, it_b.p, hit_light, it_b.ng
+    )
+    if light_idx is not None:
+        n_l = dev["light"]["type"].shape[0]
+        lpdf_area = lpdf_area * n_l  # undo the uniform pmf folded by emitted_pdf
+    # escaped ray toward the env light
+    if "envmap" in dev:
+        from tpu_pbrt.scene.compiler import LIGHT_INFINITE
+
+        is_env_row = (
+            dev["light"]["type"][jnp.maximum(light_idx, 0)] == LIGHT_INFINITE
+            if light_idx is not None
+            else None
+        )
+        le_env = ld.env_lookup(dev, wi_w)
+        lpdf_env = ld.infinite_pdf(dev, None if light_idx is not None else light_distr, wi_w)
+        if light_idx is not None:
+            lpdf_env = lpdf_env * dev["light"]["type"].shape[0]
+        miss = hit_b.prim < 0
+        if light_idx is not None:
+            miss = miss & is_env_row
+        le_b = jnp.where(miss[..., None], le_env, le_b)
+        lpdf = jnp.where(miss, lpdf_env, jnp.where(hit_emissive, lpdf_area, 0.0))
+        got_light = miss | hit_emissive
+    else:
+        lpdf = jnp.where(hit_emissive, lpdf_area, 0.0)
+        got_light = hit_emissive
+    w_b = power_heuristic(1.0, bs.pdf, 1.0, lpdf)
+    contrib_b = f_b * le_b * (w_b / jnp.maximum(bs.pdf, 1e-20))[..., None]
+    L = L + jnp.where((do_b & got_light & (lpdf > 0.0))[..., None], contrib_b, 0.0)
+    return L
+
+
+class WavefrontIntegrator:
+    """Base class: the chunked render loop (SamplerIntegrator::Render)."""
+
+    #: extra rays traced per camera ray inside li() (for the Mray/s meter)
+    rays_per_camera_ray: float = 1.0
+
+    def __init__(self, params, scene, options):
+        self.params = params
+        self.scene = scene
+        self.options = options
+        strategy = scene.light_distribution_name
+        # "uniform" -> None; "power"/"spatial" -> power distribution (the
+        # voxel-hashed SpatialLightDistribution falls back to power here)
+        self.light_distr = None if strategy == "uniform" else scene.light_distr
+
+    # -- subclass hook ----------------------------------------------------
+    def li(self, dev, o, d, px, py, s):
+        raise NotImplementedError
+
+    # -- the loop ---------------------------------------------------------
+    def render(self, scene=None, mesh=None, checkpoint_path=None, checkpoint_every=0) -> RenderResult:
+        """The SamplerIntegrator::Render loop. mesh=None runs single-device;
+        a jax.sharding.Mesh runs the SPMD tile scheduler (parallel/mesh.py):
+        work indices round-robined across devices, film merged by psum."""
+        scene = scene or self.scene
+        if mesh is None and getattr(self.options, "mesh_shape", None):
+            import jax as _jax
+
+            from tpu_pbrt.parallel.mesh import make_mesh
+
+            n_req = int(np.prod(self.options.mesh_shape))
+            if n_req > 1 and len(_jax.devices()) >= n_req:
+                mesh = make_mesh(n_req)
+        film = scene.film
+        cam = scene.camera
+        dev = scene.dev
+        x0, x1, y0, y1 = film.sample_bounds()
+        w = x1 - x0
+        h = y1 - y0
+        npix = w * h
+        spp = scene.sampler.spp
+        total = npix * spp
+        n_dev = 1 if mesh is None else mesh.devices.size
+        import os as _os
+
+        chunk = int(_os.environ.get("TPU_PBRT_CHUNK", min(MAX_RAYS_PER_DISPATCH >> 1, 1 << 17)))
+        chunk = min(chunk, max(1024 * n_dev, total))
+        chunk = (chunk // n_dev) * n_dev
+        per_dev = chunk // n_dev
+        n_chunks = (total + chunk - 1) // chunk
+
+        def body(dev, start_pix, start_s, n_rays_in_body):
+            """Film contribution of work items [start, start+n) — a pure
+            function of the work range (idempotent: the checkpoint/re-
+            dispatch unit, SURVEY.md §5.3/5.4). The global work index
+            (pix*spp + sample) can exceed int32 at production spp, so the
+            start is carried as (start_pix, start_s) and the arithmetic
+            stays within int32."""
+            k = jnp.arange(n_rays_in_body, dtype=jnp.int32)
+            s_tot = start_s + k
+            pix = start_pix + s_tot // spp
+            s = s_tot % spp
+            valid = pix < npix
+            px = x0 + pix % w
+            py = y0 + pix // w
+            # film sample: per-pixel scrambled (0,2)-sequence
+            sx_scr = hash_u32(px, py, 0x11)
+            sy_scr = hash_u32(px, py, 0x22)
+            fx, fy = sobol_2d(s, sx_scr, sy_scr)
+            p_film = jnp.stack([px.astype(jnp.float32) + fx, py.astype(jnp.float32) + fy], axis=-1)
+            u_lens = jnp.stack(
+                [uniform_float(px, py, s, DIM_LENS), uniform_float(px, py, s, DIM_LENS + 1)],
+                axis=-1,
+            )
+            o, d, wt = generate_rays(cam, p_film, u_lens)
+            L, nrays = self.li(dev, o, d, px, py, s)
+            nrays = jnp.sum(jnp.where(valid, nrays, 0))
+            p_film = jnp.where(valid[..., None], p_film, -1e6)  # lands outside crop
+            return p_film, L, wt, nrays
+
+        def split_start(g0):
+            """Global work index (python int, unbounded) -> int32 pair."""
+            return g0 // spp, g0 % spp
+
+        if mesh is None:
+
+            def chunk_fn(state: FilmState, dev, start_pix, start_s):
+                p_film, L, wt, nrays = body(dev, start_pix, start_s, chunk)
+                return film.add_samples(state, p_film, L, wt), nrays
+
+            jfn = jax.jit(chunk_fn, donate_argnums=(0,))
+            starts = [
+                tuple(jnp.int32(v) for v in split_start(c * chunk)) for c in range(n_chunks)
+            ]
+        else:
+            from tpu_pbrt.parallel.mesh import sharded_chunk_renderer
+
+            def per_device_fn(dev, start):
+                # start: this device's (1, 2) shard of the (n_dev, 2) pairs
+                p_film, L, wt, nrays = body(dev, start[0, 0], start[0, 1], per_dev)
+                contrib = film.add_samples(film.init_state(), p_film, L, wt)
+                return contrib, nrays
+
+            step = sharded_chunk_renderer(mesh, per_device_fn)
+
+            def chunk_fn(state: FilmState, dev, starts):
+                contrib, nrays = step(dev, starts)
+                from tpu_pbrt.core.film import merge_film
+
+                return merge_film(state, contrib), nrays
+
+            jfn = jax.jit(chunk_fn, donate_argnums=(0,))
+            starts = []
+            for c in range(n_chunks):
+                pairs = [split_start(c * chunk + i * per_dev) for i in range(n_dev)]
+                starts.append(jnp.asarray(pairs, jnp.int32))  # (n_dev, 2)
+
+        # -- checkpoint/resume (SURVEY.md §5.4): film accumulation is
+        # associative and chunks are idempotent, so a checkpoint is just
+        # (film state, chunk cursor); the counter-based RNG makes resumed
+        # renders bit-identical to uninterrupted ones.
+        from tpu_pbrt.utils.stats import STATS, ProgressReporter
+
+        ckpt_path = checkpoint_path or getattr(self.options, "checkpoint_path", None)
+        checkpoint_every = checkpoint_every or getattr(self.options, "checkpoint_every", 0)
+        first_chunk = 0
+        prev_rays = 0
+        state = film.init_state()
+        if ckpt_path and _os.path.exists(ckpt_path):
+            state, first_chunk, prev_rays = load_checkpoint(ckpt_path)
+
+        quiet = bool(getattr(self.options, "quiet", False))
+        progress = ProgressReporter(n_chunks, "Rendering", quiet=quiet)
+        ray_counts = []
+        t0 = time.time()
+        with STATS.phase("Integrator/Render loop"):
+            for c in range(first_chunk, n_chunks):
+                st = starts[c]
+                if mesh is None:
+                    state, nrays = jfn(state, dev, st[0], st[1])
+                else:
+                    state, nrays = jfn(state, dev, st)
+                ray_counts.append(nrays)  # defer the sync: keep the pipe full
+                progress.update()
+                if ckpt_path and checkpoint_every and (c + 1) % checkpoint_every == 0:
+                    save_checkpoint(
+                        ckpt_path, state, c + 1, prev_rays + sum(int(r) for r in ray_counts)
+                    )
+            jax.block_until_ready(state)
+        secs = time.time() - t0
+        progress.done()
+        rays = prev_rays + int(sum(int(r) for r in ray_counts))
+        STATS.counter("Integrator/Rays traced", rays)
+        STATS.counter("Integrator/Camera rays traced", total)
+        STATS.distribution("Integrator/Rays per camera ray", rays / max(total, 1))
+        if ckpt_path:
+            save_checkpoint(ckpt_path, state, n_chunks, rays)
+        img = film.develop(state)
+        if film.filename:
+            try:
+                film.write_image(state)
+            except Exception as e:  # noqa: BLE001
+                from tpu_pbrt.utils.error import Warning as _W
+
+                _W(f"could not write image {film.filename}: {e}")
+        return RenderResult(
+            image=img,
+            film_state=state,
+            seconds=secs,
+            rays_traced=rays,
+            mray_per_sec=rays / max(secs, 1e-9) / 1e6,
+            spp=spp,
+        )

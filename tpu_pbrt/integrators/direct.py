@@ -1,0 +1,109 @@
+"""DirectLightingIntegrator.
+
+Capability match for pbrt-v3 src/integrators/directlighting.{h,cpp}:
+strategies UniformSampleAll / UniformSampleOne, maxdepth specular recursion
+(Whitted-style mirror/glass continuation). The cornell-box config's
+integrator (SURVEY.md §2c).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tpu_pbrt.accel.traverse import bvh_intersect
+from tpu_pbrt.core import bxdf
+from tpu_pbrt.core import lights_dev as ld
+from tpu_pbrt.core.sampling import uniform_float
+from tpu_pbrt.core.vecmath import dot, normalize, offset_ray_origin, to_world
+from tpu_pbrt.integrators.common import (
+    DIM_BSDF_LOBE,
+    DIM_BSDF_UV,
+    DIMS_PER_BOUNCE,
+    WavefrontIntegrator,
+    estimate_direct,
+    make_interaction,
+)
+from tpu_pbrt.utils.error import Warning
+
+
+class DirectLightingIntegrator(WavefrontIntegrator):
+    name = "directlighting"
+
+    def __init__(self, params, scene, options):
+        super().__init__(params, scene, options)
+        self.max_depth = params.find_one_int("maxdepth", 5)
+        strategy = params.find_one_string("strategy", "all")
+        if strategy not in ("all", "one"):
+            Warning(f'Strategy "{strategy}" for direct lighting unknown. Using "all".')
+            strategy = "all"
+        self.set_strategy(strategy)
+
+    def set_strategy(self, strategy: str):
+        """Keeps strategy and the all-lights unroll count in sync."""
+        self.strategy = strategy
+        # "all" loops every light each shading point; cap the unroll
+        if strategy == "all" and self.scene.n_lights > 16:
+            Warning(
+                f"UniformSampleAll over {self.scene.n_lights} lights would unroll "
+                f"{self.scene.n_lights} NEE taps; falling back to one-light sampling."
+            )
+            self.strategy = "one"
+        self.n_light_loop = self.scene.n_lights if self.strategy == "all" else 1
+
+    def li(self, dev, o, d, px, py, s):
+        L = jnp.zeros(o.shape[:-1] + (3,), jnp.float32)
+        beta = jnp.ones_like(L)
+        alive = jnp.ones(o.shape[:-1], bool)
+        nrays = jnp.zeros(o.shape[:-1], jnp.int32)
+        n_lights = dev["light"]["type"].shape[0]
+
+        for depth in range(self.max_depth):
+            hit = bvh_intersect(dev["bvh"], dev["tri_verts"], o, d, jnp.inf)
+            nrays = nrays + alive.astype(jnp.int32)
+            it = make_interaction(dev, hit, o, d)
+            it.valid = it.valid & alive
+            miss = alive & (hit.prim < 0)
+            if "envmap" in dev:
+                L = L + jnp.where(miss[..., None], beta * ld.env_lookup(dev, d), 0.0)
+            # emitted at the hit (camera/specular paths see emitters directly)
+            le = ld.emitted_radiance(dev, jnp.where(it.valid, it.light, -1), it.wo, it.ng)
+            L = L + beta * le
+
+            mp = bxdf.gather_mat(dev["mat"], it.mat)
+            if self.strategy == "all":
+                for li_i in range(self.n_light_loop):
+                    idx = jnp.full(o.shape[:-1], li_i, jnp.int32)
+                    Ld = estimate_direct(
+                        dev, self.light_distr, it, mp, px, py, s,
+                        depth, light_idx=idx, salt_extra=li_i * 1000,
+                    )
+                    L = L + jnp.where(it.valid[..., None], beta * Ld, 0.0)
+                    nrays = nrays + 2 * it.valid.astype(jnp.int32)
+            else:
+                Ld = estimate_direct(dev, self.light_distr, it, mp, px, py, s, depth)
+                L = L + jnp.where(it.valid[..., None], beta * Ld, 0.0)
+                nrays = nrays + 2 * it.valid.astype(jnp.int32)
+
+            if depth + 1 >= self.max_depth:
+                break
+            # specular continuation only (directlighting.cpp SpecularReflect/
+            # SpecularTransmit): non-specular paths stop here
+            salt = depth * DIMS_PER_BOUNCE
+            from tpu_pbrt.core.vecmath import to_local
+
+            wo_l = to_local(it.wo, it.ss, it.ts, it.ns)
+            ul = uniform_float(px, py, s, salt + DIM_BSDF_LOBE + 77)
+            u1 = uniform_float(px, py, s, salt + DIM_BSDF_UV + 77)
+            u2 = uniform_float(px, py, s, salt + DIM_BSDF_UV + 177)
+            bs = bxdf.bsdf_sample(mp, wo_l, ul, u1, u2)
+            cont = it.valid & bs.is_specular & (bs.pdf > 0.0)
+            wi_w = normalize(to_world(bs.wi, it.ss, it.ts, it.ns))
+            beta = jnp.where(
+                cont[..., None],
+                beta * bs.f * (jnp.abs(dot(wi_w, it.ns)) / jnp.maximum(bs.pdf, 1e-20))[..., None],
+                beta,
+            )
+            o = jnp.where(cont[..., None], offset_ray_origin(it.p, it.ng, wi_w), o)
+            d = jnp.where(cont[..., None], wi_w, d)
+            alive = cont
+        return L, nrays

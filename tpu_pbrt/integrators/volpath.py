@@ -1,0 +1,196 @@
+"""VolPathIntegrator — path tracing with participating media.
+
+Capability match for pbrt-v3 src/integrators/volpath.{h,cpp} (the cloud
+config, SURVEY.md §2c): every ray segment runs Medium::Sample against the
+ray's current medium; medium interactions scatter by the Henyey-Greenstein
+phase function with NEE (shadow rays carry transmittance), surface
+interactions shade as in the path integrator; null-BSDF (medium-transition)
+surfaces pass through and flip the ray's medium per the MediumInterface.
+
+Wavefront redesign notes (vs the reference's recursive Li):
+- the per-ray "current medium" pointer becomes an int32 medium id in the
+  ray state, switched on interface crossings via tri_med_in/out;
+- VisibilityTester::Tr's interface walk is approximated by the current
+  medium's transmittance over the shadow segment (exact for the target
+  cloud.pbrt topology: camera and lights outside one medium region);
+- pbrt doesn't count null-interface crossings as bounces (bounces--);
+  here the loop runs PASSTHROUGH_MARGIN extra iterations instead, which
+  bounds compile-time unrolling while matching typical interface depth.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tpu_pbrt.accel.traverse import bvh_intersect, bvh_intersect_p
+from tpu_pbrt.core import bxdf
+from tpu_pbrt.core import lights_dev as ld
+from tpu_pbrt.core import media as md
+from tpu_pbrt.core.sampling import power_heuristic, uniform_float
+from tpu_pbrt.core.vecmath import dot, normalize, offset_ray_origin, to_local, to_world
+from tpu_pbrt.integrators.common import (
+    DIM_BSDF_LOBE,
+    DIM_BSDF_UV,
+    DIM_LIGHT_PICK,
+    DIM_LIGHT_UV,
+    DIM_RR,
+    DIMS_PER_BOUNCE,
+    WavefrontIntegrator,
+    make_interaction,
+)
+from tpu_pbrt.scene.compiler import MAT_NONE
+
+PASSTHROUGH_MARGIN = 4
+_DIM_MEDIUM = 12
+_DIM_PHASE = 14
+
+
+class VolPathIntegrator(WavefrontIntegrator):
+    name = "volpath"
+
+    def __init__(self, params, scene, options):
+        super().__init__(params, scene, options)
+        self.max_depth = params.find_one_int("maxdepth", 5)
+        self.rr_threshold = params.find_one_float("rrthreshold", 1.0)
+        self.camera_medium = scene.camera_medium_id
+
+    def li(self, dev, o, d, px, py, s):
+        shape = o.shape[:-1]
+        mt: md.MediumTable = dev["media"]
+        L = jnp.zeros(shape + (3,), jnp.float32)
+        beta = jnp.ones(shape + (3,), jnp.float32)
+        alive = jnp.ones(shape, bool)
+        nrays = jnp.zeros(shape, jnp.int32)
+        prev_pdf = jnp.zeros(shape, jnp.float32)
+        specular = jnp.ones(shape, bool)
+        eta_scale = jnp.ones(shape, jnp.float32)
+        prev_p = o
+        cur_med = jnp.full(shape, self.camera_medium, jnp.int32)
+
+        for bounce in range(self.max_depth + 1 + PASSTHROUGH_MARGIN):
+            salt = bounce * DIMS_PER_BOUNCE
+            hit = bvh_intersect(dev["bvh"], dev["tri_verts"], o, d, jnp.inf)
+            nrays = nrays + alive.astype(jnp.int32)
+            it = make_interaction(dev, hit, o, d)
+            it.valid = it.valid & alive
+            miss = alive & (hit.prim < 0)
+
+            # ---- medium sampling over the segment -----------------------
+            t_seg = jnp.where(hit.prim >= 0, hit.t, jnp.full_like(hit.t, jnp.inf))
+            ms = md.medium_sample(mt, jnp.where(alive, cur_med, -1), o, d, t_seg, px, py, s, salt + _DIM_MEDIUM)
+            beta = beta * jnp.where(alive[..., None], ms.weight, 1.0)
+            in_medium = alive & ms.sampled_medium
+            at_surface = alive & (hit.prim >= 0) & ~in_medium
+            escaped = miss & ~in_medium
+
+            # ---- emitted radiance (surface / env) with forward MIS ------
+            if "envmap" in dev:
+                le_env = ld.env_lookup(dev, d)
+                pdf_env = ld.infinite_pdf(dev, self.light_distr, d)
+                w_env = jnp.where(specular, 1.0, power_heuristic(1.0, prev_pdf, 1.0, pdf_env))
+                L = L + jnp.where(escaped[..., None], beta * le_env * w_env[..., None], 0.0)
+            hit_light = jnp.where(at_surface, it.light, -1)
+            le = ld.emitted_radiance(dev, hit_light, it.wo, it.ng)
+            pdf_light = ld.emitted_pdf(dev, self.light_distr, prev_p, it.p, hit_light, it.ng)
+            w_emit = jnp.where(specular, 1.0, power_heuristic(1.0, prev_pdf, 1.0, pdf_light))
+            L = L + beta * le * w_emit[..., None]
+
+            alive = in_medium | at_surface
+            if bounce >= self.max_depth + PASSTHROUGH_MARGIN:
+                break
+
+            # ---- null material passthrough (medium transition) ----------
+            mp = bxdf.gather_mat(dev["mat"], it.mat)
+            is_null = at_surface & (mp.mtype == MAT_NONE)
+            going_in_null = dot(d, it.ng) < 0.0
+            med_in = dev["tri_med_in"][jnp.maximum(hit.prim, 0)]
+            med_out = dev["tri_med_out"][jnp.maximum(hit.prim, 0)]
+            new_med_null = jnp.where(going_in_null, med_in, med_out)
+            at_surface = at_surface & ~is_null
+
+            # ---- NEE ----------------------------------------------------
+            p_medium = o + ms.t[..., None] * d
+            ref_p = jnp.where(in_medium[..., None], p_medium, it.p)
+            u_pick = uniform_float(px, py, s, salt + DIM_LIGHT_PICK)
+            u1 = uniform_float(px, py, s, salt + DIM_LIGHT_UV)
+            u2 = uniform_float(px, py, s, salt + DIM_LIGHT_UV + 100)
+            ls = ld.sample_one_light(dev, self.light_distr, ref_p, u_pick, u1, u2)
+            # scatter function value and pdf toward the light
+            wo_l = to_local(it.wo, it.ss, it.ts, it.ns)
+            wi_l = to_local(ls.wi, it.ss, it.ts, it.ns)
+            f_surf, pdf_surf = bxdf.bsdf_eval(mp, wo_l, wi_l)
+            f_surf = f_surf * jnp.abs(dot(ls.wi, it.ns))[..., None]
+            g_hg = mt.g[jnp.maximum(cur_med, 0)]
+            p_phase = md.hg_p(dot(-d, ls.wi), g_hg)
+            f_nee = jnp.where(in_medium[..., None], p_phase[..., None].repeat(3, -1), f_surf)
+            pdf_nee_fwd = jnp.where(in_medium, p_phase, pdf_surf)
+            do_nee = (in_medium | at_surface) & (ls.pdf > 0.0) & (
+                jnp.max(f_nee, axis=-1) > 0.0
+            ) & (jnp.max(ls.li, axis=-1) > 0.0)
+            o_sh = jnp.where(
+                in_medium[..., None], p_medium, offset_ray_origin(it.p, it.ng, ls.wi)
+            )
+            occluded = bvh_intersect_p(dev["bvh"], dev["tri_verts"], o_sh, ls.wi, ls.dist * 0.999)
+            nrays = nrays + do_nee.astype(jnp.int32)
+            # transmittance along the shadow segment through the current medium
+            tr_sh = md.medium_tr(
+                mt, jnp.where(do_nee, cur_med, -1), o_sh, ls.wi, ls.dist, px, py, s, salt + _DIM_MEDIUM + 1
+            )
+            w_l = jnp.where(ls.is_delta, 1.0, power_heuristic(1.0, ls.pdf, 1.0, pdf_nee_fwd))
+            Ld = f_nee * ls.li * tr_sh * (w_l / jnp.maximum(ls.pdf, 1e-20))[..., None]
+            L = L + jnp.where((do_nee & ~occluded)[..., None], beta * Ld, 0.0)
+
+            # ---- continuation -------------------------------------------
+            # medium: HG sample
+            up1 = uniform_float(px, py, s, salt + _DIM_PHASE)
+            up2 = uniform_float(px, py, s, salt + _DIM_PHASE + 1)
+            # sample around wo = -d, matching the hg_p(dot(-d, wi)) eval
+            wi_m, pdf_m = md.hg_sample(-d, g_hg, up1, up2)
+            wi_m = normalize(wi_m)
+
+            # surface: BSDF sample
+            ul = uniform_float(px, py, s, salt + DIM_BSDF_LOBE)
+            ub1 = uniform_float(px, py, s, salt + DIM_BSDF_UV)
+            ub2 = uniform_float(px, py, s, salt + DIM_BSDF_UV + 100)
+            bs = bxdf.bsdf_sample(mp, wo_l, ul, ub1, ub2)
+            wi_surf = normalize(to_world(bs.wi, it.ss, it.ts, it.ns))
+            cont_surf = at_surface & (bs.pdf > 0.0) & (jnp.max(bs.f, axis=-1) > 0.0)
+            throughput = bs.f * (jnp.abs(dot(wi_surf, it.ns)) / jnp.maximum(bs.pdf, 1e-20))[..., None]
+
+            # merge the three continuation kinds: medium / surface / null
+            cont = in_medium | cont_surf | is_null
+            beta = jnp.where(cont_surf[..., None], beta * throughput, beta)
+            new_d = jnp.where(in_medium[..., None], wi_m, wi_surf)
+            new_d = jnp.where(is_null[..., None], d, new_d)
+            new_o = jnp.where(
+                in_medium[..., None],
+                p_medium,
+                offset_ray_origin(it.p, it.ng, new_d),
+            )
+            prev_p = jnp.where(cont[..., None], jnp.where(in_medium[..., None], p_medium, it.p), prev_p)
+            o = jnp.where(cont[..., None], new_o, o)
+            d = jnp.where(cont[..., None], new_d, d)
+            prev_pdf = jnp.where(in_medium, pdf_m, jnp.where(cont_surf, bs.pdf, prev_pdf))
+            specular = jnp.where(in_medium, False, jnp.where(cont_surf, bs.is_specular, specular))
+            # medium transitions: null interface or transmissive BSDF crossing
+            crossing = cont_surf & bs.is_transmission
+            going_in = dot(new_d, it.ng) < 0.0
+            new_med_cross = jnp.where(going_in, med_in, med_out)
+            cur_med = jnp.where(is_null, new_med_null, cur_med)
+            cur_med = jnp.where(crossing, new_med_cross, cur_med)
+            # eta tracking for RR
+            eta2 = (mp.eta[..., 0]) ** 2
+            scale = jnp.where(dot(it.wo, it.ns) > 0.0, eta2, 1.0 / jnp.maximum(eta2, 1e-12))
+            eta_scale = jnp.where(crossing, eta_scale * scale, eta_scale)
+            alive = cont
+
+            # ---- Russian roulette ---------------------------------------
+            if bounce > 3:
+                rr_beta = jnp.max(beta, axis=-1) * eta_scale
+                q = jnp.maximum(0.05, 1.0 - rr_beta)
+                u_rr = uniform_float(px, py, s, salt + DIM_RR)
+                kill = alive & (rr_beta < self.rr_threshold) & (u_rr < q)
+                survive = alive & (rr_beta < self.rr_threshold) & ~kill
+                beta = beta * jnp.where(survive, 1.0 / jnp.maximum(1.0 - q, 1e-6), 1.0)[..., None]
+                alive = alive & ~kill
+        return L, nrays

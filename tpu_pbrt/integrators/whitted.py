@@ -1,0 +1,21 @@
+"""WhittedIntegrator.
+
+Capability match for pbrt-v3 src/integrators/whitted.{h,cpp}: classic
+recursive ray tracing — direct lighting with *no* MIS (light sampling only,
+every light, no area-light solid-angle weighting beyond the pdf) plus
+specular reflection/transmission recursion. Implemented as the
+DirectLightingIntegrator wavefront with the all-lights strategy, which is
+the modern equivalent of WhittedIntegrator::Li's light loop.
+"""
+
+from __future__ import annotations
+
+from tpu_pbrt.integrators.direct import DirectLightingIntegrator
+
+
+class WhittedIntegrator(DirectLightingIntegrator):
+    name = "whitted"
+
+    def __init__(self, params, scene, options):
+        super().__init__(params, scene, options)
+        self.set_strategy("all")  # whitted always samples every light

@@ -35,6 +35,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--nthreads", type=int, default=0, help="host threads for scene compile (0 = all)")
     p.add_argument("--mesh", default="", help="TPU device mesh shape, e.g. '8' or '2,4' (default: all devices)")
     p.add_argument("--spp-chunk", type=int, default=0, help="samples per render chunk (0 = auto)")
+    p.add_argument("--checkpoint", default="", help="checkpoint file: resume from it if present, write to it while rendering")
+    p.add_argument("--checkpoint-every", type=int, default=16, help="chunks between checkpoint writes")
     return p
 
 
@@ -49,6 +51,8 @@ def main(argv=None) -> int:
         crop_window=tuple(args.cropwindow) if args.cropwindow else None,
         mesh_shape=tuple(int(x) for x in args.mesh.split(",")) if args.mesh else None,
         spp_chunk=args.spp_chunk,
+        checkpoint_path=args.checkpoint,
+        checkpoint_every=args.checkpoint_every,
     )
     for scene in args.scenes:
         try:

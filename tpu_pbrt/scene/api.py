@@ -174,6 +174,8 @@ class Options:
     crop_window: Optional[tuple] = None  # (x0,x1,y0,y1)
     mesh_shape: Optional[tuple] = None  # TPU-specific: device mesh shape
     spp_chunk: int = 0  # TPU-specific: samples per chunk (0 = auto)
+    checkpoint_path: str = ""  # TPU-specific: film checkpoint for resume
+    checkpoint_every: int = 0  # chunks between checkpoint writes (0 = off)
 
 
 class PbrtAPI:

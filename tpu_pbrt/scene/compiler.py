@@ -1,0 +1,937 @@
+"""Scene compiler: parsed scene records -> flat SoA device arrays.
+
+This is the TPU-first replacement for pbrt-v3's object graph. Where pbrt
+builds a tree of virtual-dispatch objects (GeometricPrimitive wrapping
+Shape/Material/AreaLight; src/core/primitive.h, api.cpp MakeShapes), the
+compiler lowers everything ONCE on the host into flat arrays in HBM:
+
+- all shapes tessellated/collected into one world-space triangle soup
+  (src/shapes/* capability; quadrics are tessellated, meshes are native),
+- object instances (TransformedPrimitive, api.cpp pbrtObjectInstance)
+  expanded by baking instance transforms,
+- materials lowered to a type-enum + parameter-slot table
+  (src/materials/*::ComputeScatteringFunctions capability),
+- lights lowered to a type-enum SoA table; emissive shapes become one
+  area-light row per triangle exactly as pbrt makes one DiffuseAreaLight
+  per Triangle (api.cpp MakeShapes + diffuse.cpp),
+- a BVH built over the soup and flattened to LinearBVHNode SoA
+  (accelerators/bvh.cpp), with triangle arrays permuted to leaf order so
+  leaf prims are contiguous in HBM,
+- film/camera/sampler/integrator configs resolved via the Make* factories.
+
+Tagged-union dispatch over the type enums replaces virtual calls inside the
+wavefront kernels (SURVEY.md §7 design stance).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_pbrt.accel.build import build_bvh, triangle_bounds
+from tpu_pbrt.accel.traverse import bvh_as_device_dict
+from tpu_pbrt.cameras import make_camera
+from tpu_pbrt.core.film import Film, make_film
+from tpu_pbrt.core.filters import make_filter
+from tpu_pbrt.core.sampling import Distribution1D, Distribution2D
+from tpu_pbrt.core.spectrum import luminance
+from tpu_pbrt.scene.plyreader import read_ply
+from tpu_pbrt.utils.error import Error, Warning
+from tpu_pbrt.utils.fileutil import resolve_filename
+
+# material type enum (device tagged union)
+MAT_NONE = 0
+MAT_MATTE = 1
+MAT_PLASTIC = 2
+MAT_METAL = 3
+MAT_GLASS = 4
+MAT_MIRROR = 5
+MAT_UBER = 6
+MAT_SUBSTRATE = 7
+MAT_TRANSLUCENT = 8
+MAT_DISNEY = 9
+MAT_HAIR = 10
+MAT_FOURIER = 11
+MAT_SUBSURFACE = 12
+
+_MAT_ENUM = {
+    "none": MAT_NONE,
+    "matte": MAT_MATTE,
+    "plastic": MAT_PLASTIC,
+    "metal": MAT_METAL,
+    "glass": MAT_GLASS,
+    "mirror": MAT_MIRROR,
+    "uber": MAT_UBER,
+    "substrate": MAT_SUBSTRATE,
+    "translucent": MAT_TRANSLUCENT,
+    "disney": MAT_DISNEY,
+    "hair": MAT_HAIR,
+    "fourier": MAT_FOURIER,
+    "subsurface": MAT_SUBSURFACE,
+    "kdsubsurface": MAT_SUBSURFACE,
+}
+
+# light type enum
+LIGHT_POINT = 0
+LIGHT_SPOT = 1
+LIGHT_DISTANT = 2
+LIGHT_AREA = 3
+LIGHT_INFINITE = 4
+
+
+@dataclass
+class SamplerSpec:
+    name: str
+    spp: int
+    params: Any
+
+
+@dataclass
+class CompiledScene:
+    """Host handle + the device pytree every kernel consumes."""
+
+    dev: Dict[str, Any]  # device arrays (see compile_scene for schema)
+    film: Film
+    camera: Any  # CompiledCamera
+    sampler: SamplerSpec
+    integrator_name: str
+    integrator_params: Any
+    n_tris: int
+    n_lights: int
+    world_min: np.ndarray
+    world_max: np.ndarray
+    world_center: np.ndarray
+    world_radius: float
+    has_envmap: bool = False
+    env_distribution: Optional[Distribution2D] = None
+    light_distribution_name: str = "spatial"
+    light_distr: Optional[Distribution1D] = None
+    media: Dict[str, Any] = field(default_factory=dict)
+    camera_medium_id: int = -1
+
+
+# -------------------------------------------------------------------------
+# Shape tessellation (host). Each returns (verts (T,3,3) f64 in OBJECT
+# space, normals (T,3,3) or None, uvs (T,3,2) or None).
+# -------------------------------------------------------------------------
+
+def _tess_mesh(params, scene_dir):
+    idx = params.find_int("indices")
+    P = params.find_point3("P")
+    if idx is None or P is None:
+        Error("Vertex indices and positions \"P\" must be provided with triangle mesh.")
+        return None
+    idx = np.asarray(idx, np.int64).reshape(-1, 3)
+    P = np.asarray(P, np.float64).reshape(-1, 3)
+    N = params.find_normal("N")
+    uv = params.find_point2("uv")
+    if uv is None:
+        uv = params.find_point2("st")
+        if uv is None:
+            fuv = params.find_float("uv")
+            if fuv is None:
+                fuv = params.find_float("st")
+            uv = np.asarray(fuv, np.float64).reshape(-1, 2) if fuv is not None else None
+    verts = P[idx]
+    normals = np.asarray(N, np.float64).reshape(-1, 3)[idx] if N is not None else None
+    uvs = np.asarray(uv, np.float64).reshape(-1, 2)[idx] if uv is not None else None
+    return verts, normals, uvs
+
+
+def _tess_ply(params, scene_dir):
+    fn = params.find_one_string("filename", "")
+    path = resolve_filename(fn, scene_dir)
+    if not os.path.exists(path):
+        Error(f"PLY file \"{path}\" not found.")
+        return None
+    mesh = read_ply(path)
+    idx = mesh["indices"].reshape(-1, 3)
+    verts = mesh["P"][idx]
+    normals = mesh["N"][idx] if mesh.get("N") is not None else None
+    uvs = mesh["uv"][idx] if mesh.get("uv") is not None else None
+    return verts, normals, uvs
+
+
+def _grid_to_tris(px, n_u, n_v, wrap_u=False):
+    """(n_v+1, n_u+1, 3) grid of points -> triangle list + uv + normals via
+    finite differences left to caller. Returns vertex index triples."""
+    tris = []
+    for v in range(n_v):
+        for u in range(n_u):
+            u1 = (u + 1) % (n_u + 1) if wrap_u else u + 1
+            a = v * (n_u + 1) + u
+            b = v * (n_u + 1) + u1
+            c = (v + 1) * (n_u + 1) + u1
+            d = (v + 1) * (n_u + 1) + u
+            tris.append((a, b, c))
+            tris.append((a, c, d))
+    return np.asarray(tris, np.int64)
+
+
+def _tess_param_surface(point_fn, normal_fn, u_max, v_range, n_u, n_v):
+    """Tessellate a parametric surface. point_fn(u, v) -> (3,), u in
+    [0, u_max] (phi), v in v_range."""
+    us = np.linspace(0.0, u_max, n_u + 1)
+    vs = np.linspace(v_range[0], v_range[1], n_v + 1)
+    uu, vv = np.meshgrid(us, vs)  # (n_v+1, n_u+1)
+    pts = point_fn(uu, vv)  # (n_v+1, n_u+1, 3)
+    nrm = normal_fn(uu, vv) if normal_fn is not None else None
+    idx = _grid_to_tris(pts, n_u, n_v)
+    flat_p = pts.reshape(-1, 3)
+    verts = flat_p[idx]
+    normals = nrm.reshape(-1, 3)[idx] if nrm is not None else None
+    v_den = v_range[1] - v_range[0]
+    if abs(v_den) < 1e-9:
+        v_den = 1e-9
+    uvn = np.stack([uu / max(u_max, 1e-9), (vv - v_range[0]) / v_den], axis=-1)
+    uvs = uvn.reshape(-1, 2)[idx]
+    return verts, normals, uvs
+
+
+def _tess_sphere(params, scene_dir):
+    r = params.find_one_float("radius", 1.0)
+    zmin = params.find_one_float("zmin", -r)
+    zmax = params.find_one_float("zmax", r)
+    phimax = math.radians(params.find_one_float("phimax", 360.0))
+    theta_min = math.acos(np.clip(zmin / r, -1, 1))
+    theta_max = math.acos(np.clip(zmax / r, -1, 1))
+    n_u, n_v = 64, 32
+
+    def pt(u, v):
+        # v: theta from theta_min(at zmin)→theta_max; pbrt params z from zmin..zmax
+        theta = v
+        return np.stack(
+            [r * np.sin(theta) * np.cos(u), r * np.sin(theta) * np.sin(u), r * np.cos(theta)],
+            axis=-1,
+        )
+
+    def nrm(u, v):
+        p = pt(u, v)
+        return p / r
+
+    return _tess_param_surface(pt, nrm, phimax, (theta_min, theta_max), n_u, n_v)
+
+
+def _tess_disk(params, scene_dir):
+    h = params.find_one_float("height", 0.0)
+    r = params.find_one_float("radius", 1.0)
+    ri = params.find_one_float("innerradius", 0.0)
+    phimax = math.radians(params.find_one_float("phimax", 360.0))
+    n_u, n_v = 64, 1
+
+    def pt(u, v):
+        rad = ri + (r - ri) * v
+        return np.stack([rad * np.cos(u), rad * np.sin(u), np.full_like(u, h)], axis=-1)
+
+    def nrm(u, v):
+        return np.broadcast_to(np.array([0.0, 0.0, 1.0]), u.shape + (3,))
+
+    return _tess_param_surface(pt, nrm, phimax, (0.0, 1.0), n_u, n_v)
+
+
+def _tess_cylinder(params, scene_dir):
+    r = params.find_one_float("radius", 1.0)
+    zmin = params.find_one_float("zmin", -1.0)
+    zmax = params.find_one_float("zmax", 1.0)
+    phimax = math.radians(params.find_one_float("phimax", 360.0))
+
+    def pt(u, v):
+        return np.stack([r * np.cos(u), r * np.sin(u), v], axis=-1)
+
+    def nrm(u, v):
+        return np.stack([np.cos(u), np.sin(u), np.zeros_like(u)], axis=-1)
+
+    return _tess_param_surface(pt, nrm, phimax, (zmin, zmax), 64, 8)
+
+
+def _tess_cone(params, scene_dir):
+    r = params.find_one_float("radius", 1.0)
+    h = params.find_one_float("height", 1.0)
+    phimax = math.radians(params.find_one_float("phimax", 360.0))
+
+    def pt(u, v):
+        rad = r * (1.0 - v / h)
+        return np.stack([rad * np.cos(u), rad * np.sin(u), v], axis=-1)
+
+    return _tess_param_surface(pt, None, phimax, (0.0, h * (1 - 1e-6)), 64, 16)
+
+
+def _tess_paraboloid(params, scene_dir):
+    r = params.find_one_float("radius", 1.0)
+    zmin = params.find_one_float("zmin", 0.0)
+    zmax = params.find_one_float("zmax", 1.0)
+    phimax = math.radians(params.find_one_float("phimax", 360.0))
+
+    def pt(u, v):
+        rad = r * np.sqrt(np.maximum(v, 0.0) / zmax)
+        return np.stack([rad * np.cos(u), rad * np.sin(u), v], axis=-1)
+
+    return _tess_param_surface(pt, None, phimax, (zmin, zmax), 64, 16)
+
+
+def _tess_hyperboloid(params, scene_dir):
+    p1 = np.asarray(params.find_one_point3("p1", [0.0, 0.0, 0.0]), np.float64)
+    p2 = np.asarray(params.find_one_point3("p2", [1.0, 1.0, 1.0]), np.float64)
+    phimax = math.radians(params.find_one_float("phimax", 360.0))
+
+    def pt(u, v):
+        p = p1[None, None] * (1 - v[..., None]) + p2[None, None] * v[..., None]
+        xr = np.cos(u) * p[..., 0] - np.sin(u) * p[..., 1]
+        yr = np.sin(u) * p[..., 0] + np.cos(u) * p[..., 1]
+        return np.stack([xr, yr, p[..., 2]], axis=-1)
+
+    return _tess_param_surface(pt, None, phimax, (0.0, 1.0), 64, 16)
+
+
+def _tess_heightfield(params, scene_dir):
+    nu = params.find_one_int("nu", -1)
+    nv = params.find_one_int("nv", -1)
+    z = params.find_float("Pz")
+    if nu <= 0 or nv <= 0 or z is None:
+        Error("heightfield2 requires nu, nv, Pz")
+        return None
+    z = np.asarray(z, np.float64).reshape(nv, nu)
+    xs = np.linspace(0, 1, nu)
+    ys = np.linspace(0, 1, nv)
+    xx, yy = np.meshgrid(xs, ys)
+    pts = np.stack([xx, yy, z], axis=-1)
+    idx = _grid_to_tris(pts, nu - 1, nv - 1)
+    flat = pts.reshape(-1, 3)
+    uv = np.stack([xx, yy], axis=-1).reshape(-1, 2)
+    return flat[idx], None, uv[idx]
+
+
+def _tess_loopsubdiv(params, scene_dir):
+    from tpu_pbrt.shapes.loopsubdiv import loop_subdivide
+
+    levels = params.find_one_int("levels", params.find_one_int("nlevels", 3))
+    idx = params.find_int("indices")
+    P = params.find_point3("P")
+    if idx is None or P is None:
+        Error("loopsubdiv requires indices and P")
+        return None
+    verts, normals = loop_subdivide(
+        np.asarray(P, np.float64).reshape(-1, 3), np.asarray(idx, np.int64).reshape(-1, 3), levels
+    )
+    return verts, normals, None
+
+
+_TESSELATORS = {
+    "trianglemesh": _tess_mesh,
+    "plymesh": _tess_ply,
+    "sphere": _tess_sphere,
+    "disk": _tess_disk,
+    "cylinder": _tess_cylinder,
+    "cone": _tess_cone,
+    "paraboloid": _tess_paraboloid,
+    "hyperboloid": _tess_hyperboloid,
+    "heightfield2": _tess_heightfield,
+    "loopsubdiv": _tess_loopsubdiv,
+}
+
+
+def tessellate_shape(rec) -> Optional[tuple]:
+    fn = _TESSELATORS.get(rec.type)
+    if fn is None:
+        Warning(f'Shape "{rec.type}" unknown or not yet tessellatable; skipping.')
+        return None
+    return fn(rec.params, rec.scene_dir)
+
+
+# -------------------------------------------------------------------------
+# Texture folding: declarative texture nodes -> constant RGB/float for the
+# material table; non-constant nodes get a texture id (imagemap atlas /
+# procedural eval at shade time — compiled in textures_dev).
+# -------------------------------------------------------------------------
+
+def _fold_const(node, default):
+    """Try to reduce a texture node to a constant; returns (value, folded)."""
+    if node is None:
+        return default, True
+    if isinstance(node, tuple):
+        tag = node[0]
+        if tag in ("const", "constf"):
+            return node[1], True
+        if tag == "scale":
+            a, fa = _fold_const(node[1], 1.0)
+            b, fb = _fold_const(node[2], 1.0)
+            if fa and fb:
+                return np.asarray(a) * np.asarray(b), True
+        if tag == "mix":
+            a, fa = _fold_const(node[1], 0.0)
+            b, fb = _fold_const(node[2], 1.0)
+            t, ft = _fold_const(node[3], 0.5)
+            if fa and fb and ft:
+                return np.asarray(a) * (1 - np.asarray(t)) + np.asarray(b) * np.asarray(t), True
+        return default, False
+    # plain value (float or rgb array) captured directly by TextureParams
+    return node, True
+
+
+def _rgb(v) -> np.ndarray:
+    a = np.asarray(v, np.float64).reshape(-1)
+    if a.size == 1:
+        return np.full(3, float(a[0]))
+    return a[:3]
+
+
+# -------------------------------------------------------------------------
+# Material lowering
+# -------------------------------------------------------------------------
+
+_ROUGH_SLOTS = ("roughness", "uroughness", "vroughness")
+
+
+def lower_materials(mat_records: List, tex_registry) -> Dict[str, np.ndarray]:
+    """MaterialRecords -> SoA table. tex_registry assigns ids to
+    non-constant textures (returns -1 for constants)."""
+    m = len(mat_records)
+    tab = {
+        "type": np.zeros(m, np.int32),
+        "kd": np.zeros((m, 3), np.float32),
+        "ks": np.zeros((m, 3), np.float32),
+        "kr": np.zeros((m, 3), np.float32),
+        "kt": np.zeros((m, 3), np.float32),
+        "eta": np.ones((m, 3), np.float32),
+        "k": np.zeros((m, 3), np.float32),
+        "rough_u": np.zeros(m, np.float32),
+        "rough_v": np.zeros(m, np.float32),
+        "sigma": np.zeros(m, np.float32),
+        "opacity": np.ones((m, 3), np.float32),
+        "remap": np.ones(m, np.int32),
+        "kd_tex": np.full(m, -1, np.int32),
+        "ks_tex": np.full(m, -1, np.int32),
+        "sigma_tex": np.full(m, -1, np.int32),
+        "rough_tex": np.full(m, -1, np.int32),
+        "opacity_tex": np.full(m, -1, np.int32),
+        "bump_tex": np.full(m, -1, np.int32),
+    }
+
+    def fold_spec(rec, key, default, slot, tex_slot=None, i=0):
+        node = rec.params.get(key)
+        val, folded = _fold_const(node, default)
+        if not folded:
+            tid = tex_registry(node)
+            if tex_slot is not None:
+                tab[tex_slot][i] = tid
+            val, _ = _fold_const(None, default)  # fall back to default under texture
+            # average color as fallback beneath the texture lookup
+            if tid < 0:
+                Warning(f"texture for {key} not representable; using default")
+        tab[slot][i] = _rgb(val)
+        return folded
+
+    def fold_f(rec, key, default, slot, tex_slot=None, i=0):
+        node = rec.params.get(key)
+        val, folded = _fold_const(node, default)
+        if not folded:
+            tid = tex_registry(node)
+            if tex_slot is not None:
+                tab[tex_slot][i] = tid
+            val = default
+        arr = np.asarray(val, np.float64).reshape(-1)
+        tab[slot][i] = float(arr.mean())
+        return folded
+
+    for i, rec in enumerate(mat_records):
+        t = rec.type
+        tab["type"][i] = _MAT_ENUM.get(t, MAT_MATTE)
+        p = rec.params
+        if t == "matte":
+            fold_spec(rec, "Kd", 0.5, "kd", "kd_tex", i)
+            fold_f(rec, "sigma", 0.0, "sigma", "sigma_tex", i)
+        elif t == "plastic":
+            fold_spec(rec, "Kd", 0.25, "kd", "kd_tex", i)
+            fold_spec(rec, "Ks", 0.25, "ks", "ks_tex", i)
+            fold_f(rec, "roughness", 0.1, "rough_u", "rough_tex", i)
+            tab["rough_v"][i] = tab["rough_u"][i]
+            tab["remap"][i] = int(p.get("remaproughness", True))
+        elif t == "metal":
+            fold_spec(rec, "eta", 1.0, "eta", None, i)
+            fold_spec(rec, "k", 1.0, "k", None, i)
+            fold_f(rec, "roughness", 0.01, "rough_u", "rough_tex", i)
+            tab["rough_v"][i] = tab["rough_u"][i]
+            if p.get("uroughness") is not None:
+                fold_f(rec, "uroughness", 0.01, "rough_u", None, i)
+            if p.get("vroughness") is not None:
+                fold_f(rec, "vroughness", 0.01, "rough_v", None, i)
+            tab["remap"][i] = int(p.get("remaproughness", True))
+        elif t == "glass":
+            fold_spec(rec, "Kr", 1.0, "kr", None, i)
+            fold_spec(rec, "Kt", 1.0, "kt", None, i)
+            fold_f(rec, "eta", 1.5, "eta", None, i)
+            tab["eta"][i] = tab["eta"][i][:1].repeat(3)
+            fold_f(rec, "uroughness", 0.0, "rough_u", None, i)
+            fold_f(rec, "vroughness", 0.0, "rough_v", None, i)
+            tab["remap"][i] = int(p.get("remaproughness", True))
+        elif t == "mirror":
+            fold_spec(rec, "Kr", 0.9, "kr", None, i)
+        elif t == "uber":
+            fold_spec(rec, "Kd", 0.25, "kd", "kd_tex", i)
+            fold_spec(rec, "Ks", 0.25, "ks", "ks_tex", i)
+            fold_spec(rec, "Kr", 0.0, "kr", None, i)
+            fold_spec(rec, "Kt", 0.0, "kt", None, i)
+            fold_f(rec, "roughness", 0.1, "rough_u", "rough_tex", i)
+            tab["rough_v"][i] = tab["rough_u"][i]
+            if p.get("uroughness") is not None:
+                fold_f(rec, "uroughness", 0.1, "rough_u", None, i)
+            if p.get("vroughness") is not None:
+                fold_f(rec, "vroughness", 0.1, "rough_v", None, i)
+            fold_f(rec, "eta", 1.5, "eta", None, i)
+            tab["eta"][i] = tab["eta"][i][:1].repeat(3)
+            fold_spec(rec, "opacity", 1.0, "opacity", "opacity_tex", i)
+            tab["remap"][i] = int(p.get("remaproughness", True))
+        elif t == "substrate":
+            fold_spec(rec, "Kd", 0.5, "kd", "kd_tex", i)
+            fold_spec(rec, "Ks", 0.5, "ks", "ks_tex", i)
+            fold_f(rec, "uroughness", 0.1, "rough_u", "rough_tex", i)
+            fold_f(rec, "vroughness", 0.1, "rough_v", None, i)
+            tab["remap"][i] = int(p.get("remaproughness", True))
+        elif t == "translucent":
+            fold_spec(rec, "Kd", 0.25, "kd", "kd_tex", i)
+            fold_spec(rec, "Ks", 0.25, "ks", "ks_tex", i)
+            fold_spec(rec, "reflect", 0.5, "kr", None, i)
+            fold_spec(rec, "transmit", 0.5, "kt", None, i)
+            fold_f(rec, "roughness", 0.1, "rough_u", "rough_tex", i)
+            tab["rough_v"][i] = tab["rough_u"][i]
+            tab["remap"][i] = int(p.get("remaproughness", True))
+        elif t == "disney":
+            fold_spec(rec, "color", 0.5, "kd", "kd_tex", i)
+            fold_f(rec, "roughness", 0.5, "rough_u", "rough_tex", i)
+            tab["rough_v"][i] = tab["rough_u"][i]
+            fold_f(rec, "metallic", 0.0, "sigma", None, i)  # sigma slot reused
+            fold_f(rec, "eta", 1.5, "eta", None, i)
+            tab["eta"][i] = tab["eta"][i][:1].repeat(3)
+            tab["remap"][i] = 0
+        elif t in ("hair", "fourier", "subsurface", "kdsubsurface"):
+            # approximated at shade time; carry diffuse color fallback
+            Warning(f'material "{t}" approximated in this build; using closest analytic model')
+            fold_spec(rec, "Kd" if p.get("Kd") is not None else "color", 0.5, "kd", "kd_tex", i)
+            if t in ("subsurface", "kdsubsurface"):
+                fold_f(rec, "eta", 1.33, "eta", None, i)
+                tab["eta"][i] = tab["eta"][i][:1].repeat(3)
+        elif t == "mix":
+            # lower to the first material's model blended by constant amount
+            amt, _ = _fold_const(p.get("amount"), 0.5)
+            Warning("mix material lowered to linear blend of sub-material diffuse")
+            tab["type"][i] = MAT_MATTE
+            m1 = p.get("material1")
+            m2 = p.get("material2")
+            kd1, _ = _fold_const(m1.params.get("Kd") if m1 else None, 0.5)
+            kd2, _ = _fold_const(m2.params.get("Kd") if m2 else None, 0.5)
+            a = _rgb(amt)
+            tab["kd"][i] = _rgb(kd1) * a + _rgb(kd2) * (1 - a)
+        # "none" keeps zeros (passthrough)
+    return tab
+
+
+# -------------------------------------------------------------------------
+# The compile pass
+# -------------------------------------------------------------------------
+
+def _geometric_normals(verts: np.ndarray) -> np.ndarray:
+    e1 = verts[:, 1] - verts[:, 0]
+    e2 = verts[:, 2] - verts[:, 0]
+    n = np.cross(e1, e2)
+    ln = np.linalg.norm(n, axis=-1, keepdims=True)
+    n = n / np.maximum(ln, 1e-20)
+    return np.repeat(n[:, None, :], 3, axis=1)
+
+
+def compile_scene(api) -> CompiledScene:
+    ro = api.render_options
+    opts = api.options
+
+    # -- film / filter / camera / sampler --------------------------------
+    filt = make_filter(ro.filter_name, ro.filter_params)
+    film = make_film(ro.film_name, ro.film_params, filt, opts)
+    camera = make_camera(
+        ro.camera_name,
+        ro.camera_params,
+        ro.camera_to_world[0],
+        film.full_resolution,
+        (
+            ro.camera_params.find_one_float("shutteropen", 0.0),
+            ro.camera_params.find_one_float("shutterclose", 1.0),
+        ),
+    )
+    spp = ro.sampler_params.find_one_int("pixelsamples", 16)
+    if getattr(opts, "quick_render", False):
+        spp = max(1, spp // 4)
+    sampler = SamplerSpec(ro.sampler_name, spp, ro.sampler_params)
+
+    # -- gather shapes (instances expanded) ------------------------------
+    shape_list = list(ro.shapes)
+    for use in ro.instance_uses:
+        for rec in ro.instances.get(use.name, []):
+            import copy as _copy
+
+            r2 = _copy.copy(rec)
+            r2.object_to_world = type(rec.object_to_world)(
+                [use.instance_to_world[i] * rec.object_to_world[i] for i in range(2)]
+            )
+            shape_list.append(r2)
+
+    all_verts, all_normals, all_uvs = [], [], []
+    all_mat, all_light = [], []
+    mat_records: List = []
+    mat_index: Dict[int, int] = {}
+    light_rows: List[dict] = []
+    shape_tri_counts: List = []  # (ShapeRecord, n_tris) for medium interfaces
+
+    def mat_id_for(mrec):
+        if mrec is None:
+            from tpu_pbrt.scene.api import MaterialRecord
+
+            mrec = MaterialRecord("none", {})
+        key = id(mrec)
+        if key not in mat_index:
+            mat_index[key] = len(mat_records)
+            mat_records.append(mrec)
+        return mat_index[key]
+
+    for rec in shape_list:
+        tess = tessellate_shape(rec)
+        if tess is None:
+            continue
+        verts, normals, uvs = tess
+        o2w = rec.object_to_world[0]
+        wverts = o2w.apply_point(verts.reshape(-1, 3)).reshape(-1, 3, 3)
+        if normals is not None:
+            wn = o2w.apply_normal(normals.reshape(-1, 3)).reshape(-1, 3, 3)
+            ln = np.linalg.norm(wn, axis=-1, keepdims=True)
+            wn = wn / np.maximum(ln, 1e-20)
+        else:
+            wn = _geometric_normals(wverts)
+        if rec.reverse_orientation ^ o2w.swaps_handedness():
+            wn = -wn
+        if uvs is None:
+            uvs = np.zeros((len(wverts), 3, 2))
+            uvs[:, 1, 0] = 1.0
+            uvs[:, 2] = [1.0, 1.0]
+        mid = mat_id_for(rec.material)
+        n_t = len(wverts)
+        base = sum(len(v) for v in all_verts)
+        shape_tri_counts.append((rec, n_t))
+        all_verts.append(wverts)
+        all_normals.append(wn)
+        all_uvs.append(uvs)
+        all_mat.append(np.full(n_t, mid, np.int32))
+        lids = np.full(n_t, -1, np.int32)
+        if rec.area_light is not None:
+            # one DiffuseAreaLight per triangle (pbrt MakeShapes semantics)
+            L = _rgb(rec.area_light.find_one_spectrum("L", np.array([1.0, 1.0, 1.0])))
+            sc = _rgb(rec.area_light.find_one_spectrum("scale", np.array([1.0, 1.0, 1.0])))
+            two = rec.area_light.find_one_bool("twosided", False)
+            e1 = wverts[:, 1] - wverts[:, 0]
+            e2 = wverts[:, 2] - wverts[:, 0]
+            areas = 0.5 * np.linalg.norm(np.cross(e1, e2), axis=-1)
+            for k in range(n_t):
+                lids[k] = len(light_rows)
+                light_rows.append(
+                    dict(
+                        type=LIGHT_AREA,
+                        p=np.zeros(3),
+                        L=L * sc,
+                        dir=np.zeros(3),
+                        cos0=0.0,
+                        cos1=0.0,
+                        tri=base + k,
+                        twosided=int(two),
+                        area=float(areas[k]),
+                    )
+                )
+        all_light.append(lids)
+
+    if all_verts:
+        verts = np.concatenate(all_verts).astype(np.float64)
+        normals = np.concatenate(all_normals).astype(np.float32)
+        uvs = np.concatenate(all_uvs).astype(np.float32)
+        mat_ids = np.concatenate(all_mat)
+        light_ids = np.concatenate(all_light)
+    else:
+        # no geometry: a degenerate far-away triangle keeps shapes static
+        verts = np.full((1, 3, 3), 1e30)
+        normals = np.zeros((1, 3, 3), np.float32)
+        normals[:, :, 2] = 1.0
+        uvs = np.zeros((1, 3, 2), np.float32)
+        mat_ids = np.zeros(1, np.int32)
+        light_ids = np.full(1, -1, np.int32)
+        from tpu_pbrt.scene.api import MaterialRecord
+
+        mat_records.append(MaterialRecord("none", {}))
+
+    # -- world bounds ----------------------------------------------------
+    finite = np.abs(verts).max(axis=(1, 2)) < 1e29
+    if finite.any():
+        wmin = verts[finite].min(axis=(0, 1))
+        wmax = verts[finite].max(axis=(0, 1))
+    else:
+        wmin = np.full(3, -1.0)
+        wmax = np.full(3, 1.0)
+    wcenter = 0.5 * (wmin + wmax)
+    wradius = float(np.linalg.norm(wmax - wcenter)) + 1e-6
+
+    # -- BVH -------------------------------------------------------------
+    bmin, bmax = triangle_bounds(verts)
+    bvh = build_bvh(bmin, bmax, method=ro.accelerator_params.find_one_string("splitmethod", "auto")
+                    if ro.accelerator_name == "bvh" else "auto")
+    order = bvh.prim_order
+    verts = verts[order]
+    normals = normals[order]
+    uvs = uvs[order]
+    mat_ids = mat_ids[order]
+    light_ids = light_ids[order]
+    # area-light rows reference triangle ids -> remap to leaf order
+    inv_order = np.empty_like(order)
+    inv_order[order] = np.arange(len(order))
+    for row in light_rows:
+        if row["type"] == LIGHT_AREA:
+            row["tri"] = int(inv_order[row["tri"]])
+
+    # -- non-area lights -------------------------------------------------
+    envmap = None
+    env_distr = None
+    has_envmap = False
+    env_w2l = np.eye(4, dtype=np.float32)
+    for lrec in ro.lights:
+        l2w = lrec.light_to_world
+        p = lrec.params
+        sc = _rgb(p.find_one_spectrum("scale", np.array([1.0, 1.0, 1.0])))
+        if lrec.type == "point":
+            I = _rgb(p.find_one_spectrum("I", np.array([1.0, 1.0, 1.0]))) * sc
+            pos = l2w.apply_point(p.find_one_point3("from", [0.0, 0.0, 0.0]))
+            light_rows.append(dict(type=LIGHT_POINT, p=pos, L=I, dir=np.zeros(3), cos0=0, cos1=0, tri=-1, twosided=0, area=0.0))
+        elif lrec.type == "spot":
+            I = _rgb(p.find_one_spectrum("I", np.array([1.0, 1.0, 1.0]))) * sc
+            cone = p.find_one_float("coneangle", 30.0)
+            delta = p.find_one_float("conedeltaangle", 5.0)
+            frm = np.asarray(p.find_one_point3("from", [0, 0, 0]), np.float64)
+            to = np.asarray(p.find_one_point3("to", [0, 0, 1]), np.float64)
+            pos = l2w.apply_point(frm)
+            d = l2w.apply_point(to) - pos
+            d = d / max(np.linalg.norm(d), 1e-20)
+            light_rows.append(
+                dict(type=LIGHT_SPOT, p=pos, L=I, dir=d,
+                     cos0=math.cos(math.radians(cone - delta)),  # falloff start
+                     cos1=math.cos(math.radians(cone)),  # total width
+                     tri=-1, twosided=0, area=0.0)
+            )
+        elif lrec.type == "distant":
+            L = _rgb(p.find_one_spectrum("L", np.array([1.0, 1.0, 1.0]))) * sc
+            frm = np.asarray(p.find_one_point3("from", [0, 0, 0]), np.float64)
+            to = np.asarray(p.find_one_point3("to", [0, 0, 1]), np.float64)
+            d = l2w.apply_vector(frm - to)
+            d = d / max(np.linalg.norm(d), 1e-20)  # direction TOWARD light
+            light_rows.append(dict(type=LIGHT_DISTANT, p=np.zeros(3), L=L, dir=d, cos0=0, cos1=0, tri=-1, twosided=0, area=0.0))
+        elif lrec.type in ("infinite", "exinfinite"):
+            L = _rgb(p.find_one_spectrum("L", np.array([1.0, 1.0, 1.0]))) * sc
+            fn = p.find_one_string("mapname", "")
+            w2l = np.asarray(l2w.inverse().m, np.float32)
+            if fn:
+                from tpu_pbrt.utils import imageio
+
+                path = resolve_filename(fn, lrec.scene_dir)
+                try:
+                    img = imageio.read_image(path) * L[None, None]
+                    envmap = img.astype(np.float32)
+                    has_envmap = True
+                except Exception as e:  # noqa: BLE001
+                    Warning(f'could not read environment map "{path}": {e}; using constant')
+                    envmap = np.full((4, 8, 3), L, np.float32)
+                    has_envmap = True
+            else:
+                envmap = np.full((4, 8, 3), L, np.float32)
+                has_envmap = True
+            # importance distribution over luminance * sin(theta)
+            hgt, wdt = envmap.shape[:2]
+            lum = luminance(envmap)
+            theta = (np.arange(hgt) + 0.5) / hgt * np.pi
+            env_distr = Distribution2D.build(lum * np.sin(theta)[:, None])
+            light_rows.append(dict(type=LIGHT_INFINITE, p=wcenter, L=np.ones(3), dir=np.zeros(3), cos0=0, cos1=0, tri=-1, twosided=0, area=0.0))
+            # store world-to-light for map lookups
+            env_w2l = w2l
+        elif lrec.type in ("projection", "goniometric"):
+            Warning(f'light "{lrec.type}" approximated as point light')
+            I = _rgb(p.find_one_spectrum("I", np.array([1.0, 1.0, 1.0]))) * sc
+            pos = l2w.apply_point([0.0, 0.0, 0.0])
+            light_rows.append(dict(type=LIGHT_POINT, p=pos, L=I, dir=np.zeros(3), cos0=0, cos1=0, tri=-1, twosided=0, area=0.0))
+        else:
+            Warning(f'LightSource "{lrec.type}" unknown.')
+
+    # -- media (medium.cpp / media/{homogeneous,grid}.cpp lowering) ------
+    from tpu_pbrt.core.media import (
+        MEDIUM_GRID,
+        MEDIUM_HOMOGENEOUS,
+        MEDIUM_PRESETS,
+        MediumTable,
+        empty_medium_table,
+    )
+
+    medium_ids: Dict[str, int] = {"": -1}
+    med_rows = []
+    grid_density_arr = None
+    grid_w2m = np.eye(4, dtype=np.float32)
+    sigma_t_max = 0.0
+    for mname, mrec in ro.named_media.items():
+        p = mrec.params
+        scale_m = p.find_one_float("scale", 1.0)
+        g_m = p.find_one_float("g", 0.0)
+        preset = p.find_one_string("preset", "")
+        sig_a_d = np.array([0.0011, 0.0024, 0.014])
+        sig_s_d = np.array([2.55, 3.21, 3.77])
+        if preset:
+            if preset in MEDIUM_PRESETS:
+                sig_s_d, sig_a_d = MEDIUM_PRESETS[preset]
+            else:
+                Warning(f'Material preset "{preset}" not found; using defaults')
+        sig_a = _rgb(p.find_one_spectrum("sigma_a", sig_a_d)) * scale_m
+        sig_s = _rgb(p.find_one_spectrum("sigma_s", sig_s_d)) * scale_m
+        if mrec.type == "homogeneous":
+            med_rows.append(dict(type=MEDIUM_HOMOGENEOUS, sa=sig_a, ss=sig_s, g=g_m, grid=-1))
+        elif mrec.type == "heterogeneous" or mrec.type == "grid":
+            nx = p.find_one_int("nx", 1)
+            ny = p.find_one_int("ny", 1)
+            nz = p.find_one_int("nz", 1)
+            dvals = p.find_float("density")
+            if dvals is None or len(dvals) != nx * ny * nz:
+                Error(f'GridDensityMedium requires nx*ny*nz "density" values')
+            if grid_density_arr is not None:
+                Warning("multiple grid media: only one density grid supported; last wins")
+            grid_density_arr = np.asarray(dvals, np.float32).reshape(nz, ny, nx)
+            # pbrt maps medium space [0,1]^3 through p0/p2 bounds if given
+            p0 = np.asarray(p.find_one_point3("p0", [0.0, 0.0, 0.0]))
+            p1 = np.asarray(p.find_one_point3("p1", [1.0, 1.0, 1.0]))
+            m2w = mrec.medium_to_world.m @ np.block(
+                [[np.diag(p1 - p0), (p0)[:, None]], [np.zeros((1, 3)), np.ones((1, 1))]]
+            )
+            grid_w2m = np.linalg.inv(m2w).astype(np.float32)
+            sigma_t_max = float((sig_a + sig_s).max() * grid_density_arr.max())
+            med_rows.append(dict(type=MEDIUM_GRID, sa=sig_a, ss=sig_s, g=g_m, grid=0))
+        else:
+            Warning(f'Medium "{mrec.type}" unknown; ignored.')
+            med_rows.append(dict(type=MEDIUM_HOMOGENEOUS, sa=sig_a * 0, ss=sig_s * 0, g=0.0, grid=-1))
+        medium_ids[mname] = len(med_rows) - 1
+
+    if med_rows:
+        medium_table = MediumTable(
+            mtype=jnp.asarray([r["type"] for r in med_rows], jnp.int32),
+            sigma_a=jnp.asarray(np.array([r["sa"] for r in med_rows]), jnp.float32),
+            sigma_s=jnp.asarray(np.array([r["ss"] for r in med_rows]), jnp.float32),
+            g=jnp.asarray([r["g"] for r in med_rows], jnp.float32),
+            grid_id=jnp.asarray([r["grid"] for r in med_rows], jnp.int32),
+            density=jnp.asarray(
+                grid_density_arr if grid_density_arr is not None else np.zeros((1, 1, 1), np.float32)
+            ),
+            world_to_medium=jnp.asarray(grid_w2m, jnp.float32),
+            sigma_t_max=jnp.float32(sigma_t_max),
+        )
+    else:
+        medium_table = empty_medium_table()
+
+    # per-triangle medium interface ids (primitive.h MediumInterface)
+    med_in = np.full(len(verts), -1, np.int32)
+    med_out = np.full(len(verts), -1, np.int32)
+    tri_base = 0
+    for rec, n_t in shape_tri_counts:
+        med_in[tri_base : tri_base + n_t] = medium_ids.get(rec.inside_medium, -1)
+        med_out[tri_base : tri_base + n_t] = medium_ids.get(rec.outside_medium, -1)
+        tri_base += n_t
+    if len(order) == len(med_in):
+        med_in = med_in[order]
+        med_out = med_out[order]
+    camera_medium_id = medium_ids.get(ro.camera_medium, -1)
+
+    n_lights = len(light_rows)
+    if n_lights == 0:
+        Warning("No light sources defined in scene; rendering a black image.")
+        light_rows.append(dict(type=LIGHT_POINT, p=np.zeros(3), L=np.zeros(3), dir=np.zeros(3), cos0=0, cos1=0, tri=-1, twosided=0, area=0.0))
+
+    lt = {
+        "type": np.array([r["type"] for r in light_rows], np.int32),
+        "p": np.array([r["p"] for r in light_rows], np.float32),
+        "L": np.array([r["L"] for r in light_rows], np.float32),
+        "dir": np.array([r["dir"] for r in light_rows], np.float32),
+        "cos0": np.array([r["cos0"] for r in light_rows], np.float32),
+        "cos1": np.array([r["cos1"] for r in light_rows], np.float32),
+        "tri": np.array([r["tri"] for r in light_rows], np.int32),
+        "twosided": np.array([r["twosided"] for r in light_rows], np.int32),
+        "area": np.array([r["area"] for r in light_rows], np.float32),
+    }
+
+    # power-weighted light selection distribution (lightdistrib.cpp
+    # PowerLightDistribution); used when integrator asks for "power"
+    power = np.zeros(max(n_lights, 1))
+    for i, r in enumerate(light_rows[: max(n_lights, 1)]):
+        lum_v = float(luminance(np.asarray(r["L"], np.float64)))
+        if r["type"] == LIGHT_AREA:
+            power[i] = lum_v * r["area"] * np.pi * (2.0 if r["twosided"] else 1.0)
+        elif r["type"] == LIGHT_INFINITE:
+            # the row carries L=1 (radiance lives in the envmap, already
+            # scaled by L); power must reflect the map's mean luminance
+            env_lum = float(np.mean(luminance(envmap.astype(np.float64)))) if envmap is not None else lum_v
+            power[i] = env_lum * np.pi * wradius * wradius * 4
+        elif r["type"] == LIGHT_DISTANT:
+            power[i] = lum_v * np.pi * wradius * wradius
+        else:
+            power[i] = lum_v * 4 * np.pi
+    light_distr = Distribution1D.build(power if power.sum() > 0 else np.ones_like(power))
+
+    # -- materials -------------------------------------------------------
+    deferred_textures: List = []
+
+    def tex_registry(node):
+        deferred_textures.append(node)
+        return -1  # image/procedural texture lowering lands in stage 6
+
+    mtab = lower_materials(mat_records, tex_registry)
+
+    # -- device upload ---------------------------------------------------
+    dev = {
+        "bvh": bvh_as_device_dict(bvh),
+        "tri_verts": jnp.asarray(verts, jnp.float32),
+        "tri_normals": jnp.asarray(normals, jnp.float32),
+        "tri_uvs": jnp.asarray(uvs, jnp.float32),
+        "tri_mat": jnp.asarray(mat_ids, jnp.int32),
+        "tri_light": jnp.asarray(light_ids, jnp.int32),
+        "mat": {k: jnp.asarray(v) for k, v in mtab.items()},
+        "light": {k: jnp.asarray(v) for k, v in lt.items()},
+        "tri_med_in": jnp.asarray(med_in, jnp.int32),
+        "tri_med_out": jnp.asarray(med_out, jnp.int32),
+        "media": medium_table,
+        "world_center": jnp.asarray(wcenter, jnp.float32),
+        "world_radius": jnp.float32(wradius),
+        "n_lights": jnp.int32(n_lights if light_rows else 0),
+    }
+    if has_envmap:
+        dev["envmap"] = jnp.asarray(envmap, jnp.float32)
+        dev["env_distr"] = env_distr
+        dev["env_w2l"] = jnp.asarray(env_w2l[:3, :3], jnp.float32)
+
+    distrib_name = ro.integrator_params.find_one_string("lightsamplestrategy", "spatial")
+
+    return CompiledScene(
+        dev=dev,
+        film=film,
+        camera=camera,
+        sampler=sampler,
+        integrator_name=ro.integrator_name,
+        integrator_params=ro.integrator_params,
+        n_tris=len(verts),
+        n_lights=n_lights,
+        world_min=wmin,
+        world_max=wmax,
+        world_center=wcenter,
+        world_radius=wradius,
+        has_envmap=has_envmap,
+        env_distribution=env_distr,
+        light_distribution_name=distrib_name,
+        light_distr=light_distr,
+        media=dict(ro.named_media),
+        camera_medium_id=camera_medium_id,
+    )
