@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from tpu_pbrt.accel.traverse import bvh_intersect, bvh_intersect_p
 from tpu_pbrt.core.sampling import (
     UNIFORM_HEMISPHERE_PDF,
     cosine_hemisphere_pdf,
@@ -20,6 +19,8 @@ from tpu_pbrt.core.sampling import (
 )
 from tpu_pbrt.core.vecmath import dot, offset_ray_origin, to_world
 from tpu_pbrt.integrators.common import (
+    scene_intersect,
+    scene_intersect_p,
     DIM_BSDF_UV,
     WavefrontIntegrator,
     make_interaction,
@@ -35,7 +36,7 @@ class AOIntegrator(WavefrontIntegrator):
         self.max_dist = params.find_one_float("maxdistance", float("inf"))
 
     def li(self, dev, o, d, px, py, s):
-        hit = bvh_intersect(dev["bvh"], dev["tri_verts"], o, d, jnp.inf)
+        hit = scene_intersect(dev, o, d, jnp.inf)
         it = make_interaction(dev, hit, o, d)
         nrays = jnp.ones(o.shape[:-1], jnp.int32)
 
@@ -53,7 +54,7 @@ class AOIntegrator(WavefrontIntegrator):
         flip = dot(wi, it.ns) * dot(it.wo, it.ns) < 0.0
         wi = jnp.where(flip[..., None], -wi, wi)
         o_sh = offset_ray_origin(it.p, it.ng, wi)
-        occluded = bvh_intersect_p(dev["bvh"], dev["tri_verts"], o_sh, wi, self.max_dist)
+        occluded = scene_intersect_p(dev, o_sh, wi, self.max_dist)
         nrays = nrays + it.valid.astype(jnp.int32)
         cos_w = jnp.abs(dot(wi, it.ns))
         val = jnp.where(

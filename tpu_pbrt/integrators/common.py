@@ -34,6 +34,22 @@ from tpu_pbrt.accel.traverse import (
     bvh_intersect,
     bvh_intersect_p,
 )
+from tpu_pbrt.accel.wide import wide_intersect, wide_intersect_p
+
+
+def scene_intersect(dev, o, d, t_max) -> Hit:
+    """Scene::Intersect — dispatches to the wide-BVH kernel when the scene
+    compiler provides one (the TPU-shaped default), else the binary walk."""
+    if "wbvh" in dev:
+        return wide_intersect(dev["wbvh"], o, d, t_max)
+    return scene_intersect(dev, o, d, t_max)
+
+
+def scene_intersect_p(dev, o, d, t_max):
+    """Scene::IntersectP — shadow-ray predicate."""
+    if "wbvh" in dev:
+        return wide_intersect_p(dev["wbvh"], o, d, t_max)
+    return scene_intersect_p(dev, o, d, t_max)
 from tpu_pbrt.cameras import generate_rays
 from tpu_pbrt.core import bxdf
 from tpu_pbrt.core import lights_dev as ld
@@ -175,7 +191,7 @@ def estimate_direct(dev, light_distr, it: Interaction, mp, px, py, s, bounce, li
         & (jnp.max(f_b, axis=-1) > 0.0)
     )
     o_b = offset_ray_origin(it.p, it.ng, wi_w)
-    hit_b = bvh_intersect(dev["bvh"], dev["tri_verts"], o_b, wi_w, jnp.inf)
+    hit_b = scene_intersect(dev, o_b, wi_w, jnp.inf)
     hit_light = dev["tri_light"][jnp.maximum(hit_b.prim, 0)]
     hit_emissive = (hit_b.prim >= 0) & (hit_light >= 0)
     # emitted toward us?

@@ -10,12 +10,13 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from tpu_pbrt.accel.traverse import bvh_intersect
 from tpu_pbrt.core import bxdf
 from tpu_pbrt.core import lights_dev as ld
 from tpu_pbrt.core.sampling import uniform_float
 from tpu_pbrt.core.vecmath import dot, normalize, offset_ray_origin, to_world
 from tpu_pbrt.integrators.common import (
+    scene_intersect,
+    scene_intersect_p,
     DIM_BSDF_LOBE,
     DIM_BSDF_UV,
     DIMS_PER_BOUNCE,
@@ -58,7 +59,7 @@ class DirectLightingIntegrator(WavefrontIntegrator):
         n_lights = dev["light"]["type"].shape[0]
 
         for depth in range(self.max_depth):
-            hit = bvh_intersect(dev["bvh"], dev["tri_verts"], o, d, jnp.inf)
+            hit = scene_intersect(dev, o, d, jnp.inf)
             nrays = nrays + alive.astype(jnp.int32)
             it = make_interaction(dev, hit, o, d)
             it.valid = it.valid & alive

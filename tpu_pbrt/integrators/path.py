@@ -19,12 +19,13 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from tpu_pbrt.accel.traverse import bvh_intersect, bvh_intersect_p
 from tpu_pbrt.core import bxdf
 from tpu_pbrt.core import lights_dev as ld
 from tpu_pbrt.core.sampling import power_heuristic, uniform_float
 from tpu_pbrt.core.vecmath import dot, normalize, offset_ray_origin, to_local, to_world
 from tpu_pbrt.integrators.common import (
+    scene_intersect,
+    scene_intersect_p,
     DIM_BSDF_LOBE,
     DIM_BSDF_UV,
     DIM_LIGHT_PICK,
@@ -58,7 +59,7 @@ class PathIntegrator(WavefrontIntegrator):
         prev_p = o  # previous path vertex (for light pdf conversion)
 
         for bounce in range(self.max_depth + 1):
-            hit = bvh_intersect(dev["bvh"], dev["tri_verts"], o, d, jnp.inf)
+            hit = scene_intersect(dev, o, d, jnp.inf)
             nrays = nrays + alive.astype(jnp.int32)
             it = make_interaction(dev, hit, o, d)
             it.valid = it.valid & alive
@@ -100,7 +101,7 @@ class PathIntegrator(WavefrontIntegrator):
                 & (jnp.max(ls.li, axis=-1) > 0.0)
             )
             o_sh = offset_ray_origin(it.p, it.ng, ls.wi)
-            occluded = bvh_intersect_p(dev["bvh"], dev["tri_verts"], o_sh, ls.wi, ls.dist * 0.999)
+            occluded = scene_intersect_p(dev, o_sh, ls.wi, ls.dist * 0.999)
             nrays = nrays + do_nee.astype(jnp.int32)
             w_l = jnp.where(ls.is_delta, 1.0, power_heuristic(1.0, ls.pdf, 1.0, bsdf_pdf))
             Ld = f * ls.li * (w_l / jnp.maximum(ls.pdf, 1e-20))[..., None]

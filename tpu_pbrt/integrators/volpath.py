@@ -22,13 +22,14 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from tpu_pbrt.accel.traverse import bvh_intersect, bvh_intersect_p
 from tpu_pbrt.core import bxdf
 from tpu_pbrt.core import lights_dev as ld
 from tpu_pbrt.core import media as md
 from tpu_pbrt.core.sampling import power_heuristic, uniform_float
 from tpu_pbrt.core.vecmath import dot, normalize, offset_ray_origin, to_local, to_world
 from tpu_pbrt.integrators.common import (
+    scene_intersect,
+    scene_intersect_p,
     DIM_BSDF_LOBE,
     DIM_BSDF_UV,
     DIM_LIGHT_PICK,
@@ -69,7 +70,7 @@ class VolPathIntegrator(WavefrontIntegrator):
 
         for bounce in range(self.max_depth + 1 + PASSTHROUGH_MARGIN):
             salt = bounce * DIMS_PER_BOUNCE
-            hit = bvh_intersect(dev["bvh"], dev["tri_verts"], o, d, jnp.inf)
+            hit = scene_intersect(dev, o, d, jnp.inf)
             nrays = nrays + alive.astype(jnp.int32)
             it = make_interaction(dev, hit, o, d)
             it.valid = it.valid & alive
@@ -130,7 +131,7 @@ class VolPathIntegrator(WavefrontIntegrator):
             o_sh = jnp.where(
                 in_medium[..., None], p_medium, offset_ray_origin(it.p, it.ng, ls.wi)
             )
-            occluded = bvh_intersect_p(dev["bvh"], dev["tri_verts"], o_sh, ls.wi, ls.dist * 0.999)
+            occluded = scene_intersect_p(dev, o_sh, ls.wi, ls.dist * 0.999)
             nrays = nrays + do_nee.astype(jnp.int32)
             # transmittance along the shadow segment through the current medium
             tr_sh = md.medium_tr(

@@ -892,8 +892,11 @@ def compile_scene(api) -> CompiledScene:
     mtab = lower_materials(mat_records, tex_registry)
 
     # -- device upload ---------------------------------------------------
+    from tpu_pbrt.accel.wide import build_wide
+
     dev = {
         "bvh": bvh_as_device_dict(bvh),
+        "wbvh": build_wide(bvh, verts.astype(np.float32)),
         "tri_verts": jnp.asarray(verts, jnp.float32),
         "tri_normals": jnp.asarray(normals, jnp.float32),
         "tri_uvs": jnp.asarray(uvs, jnp.float32),
