@@ -59,15 +59,24 @@ def intersect_triangle(o, d, p0, p1, p2, t_max):
     p0t = p0 - o
     p1t = p1 - o
     p2t = p2 - o
-    # permute so |d| is largest along z
+    # permute so |d| is largest along z; perm derives from d alone, so it
+    # must broadcast against each operand's (possibly wider) batch shape —
+    # e.g. a single ray (3,) tested against a leaf block (M,3)
     kz = jnp.argmax(jnp.abs(d), axis=-1)
     kx = (kz + 1) % 3
     ky = (kx + 1) % 3
     perm = jnp.stack([kx, ky, kz], axis=-1)
-    dp = jnp.take_along_axis(d, perm, axis=-1)
-    p0t = jnp.take_along_axis(p0t, perm, axis=-1)
-    p1t = jnp.take_along_axis(p1t, perm, axis=-1)
-    p2t = jnp.take_along_axis(p2t, perm, axis=-1)
+
+    def permute(a):
+        shp = jnp.broadcast_shapes(a.shape, perm.shape)
+        return jnp.take_along_axis(
+            jnp.broadcast_to(a, shp), jnp.broadcast_to(perm, shp), axis=-1
+        )
+
+    dp = permute(d)
+    p0t = permute(p0t)
+    p1t = permute(p1t)
+    p2t = permute(p2t)
     # shear to align ray with +z
     inv_dz = 1.0 / dp[..., 2]
     sx = -dp[..., 0] * inv_dz
@@ -249,7 +258,26 @@ def bvh_intersect_p(bvh, tri_verts, o, d, t_max) -> jnp.ndarray:
 
 
 def bvh_as_device_dict(bvh_arrays) -> dict:
-    """BVHArrays (numpy) -> device dict consumed by the traversal kernels."""
+    """BVHArrays (numpy) -> device dict consumed by the traversal kernels.
+    Fails loudly if the tree is deeper than the fixed traversal stack."""
+    import numpy as _np
+
+    n_prims = _np.asarray(bvh_arrays.n_prims)
+    second = _np.asarray(bvh_arrays.second_child)
+    n = n_prims.shape[0]
+    depth = _np.ones(n, _np.int64)
+    # DFS layout: children have larger ids. Interior nodes are n_prims == 0
+    # with a forward second-child pointer; the Morton build also emits empty
+    # padded leaves (n_prims == 0, second == 0, inf/-inf bounds) which the
+    # traversal never descends — skip them here the same way.
+    for i in range(n - 1, -1, -1):
+        if n_prims[i] == 0 and second[i] > i and i + 1 < n:
+            depth[i] = 1 + max(depth[i + 1], depth[second[i]])
+    if int(depth[0]) > MAX_STACK:
+        raise ValueError(
+            f"binary BVH depth {int(depth[0])} exceeds MAX_STACK={MAX_STACK}; "
+            "raise MAX_STACK in accel/traverse.py"
+        )
     return {
         "bounds_min": jnp.asarray(bvh_arrays.bounds_min, jnp.float32),
         "bounds_max": jnp.asarray(bvh_arrays.bounds_max, jnp.float32),

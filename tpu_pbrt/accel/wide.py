@@ -36,7 +36,9 @@ from tpu_pbrt.accel.traverse import Hit, intersect_triangle
 from tpu_pbrt.core.vecmath import gamma
 
 WIDTH = 8
-MAX_STACK = 64
+# worst-case occupancy is (WIDTH-1)*depth + 1, checked loudly in build_wide;
+# 128 covers depth 18 (~8^18 nodes) at 512 B/lane of while_loop state
+MAX_STACK = 128
 _BOX_EPS = 1.0 + 2.0 * gamma(3)
 # wide-leaf encoding in child_idx: >= 0 interior node id;
 # < 0 leaf: -(1 + prim_offset * (MAX_LEAF_PRIMS+1) + n_prims)
@@ -48,7 +50,6 @@ class WideBVH(NamedTuple):
     child_bmin: jnp.ndarray  # (N, 8, 3)
     child_bmax: jnp.ndarray  # (N, 8, 3)
     child_idx: jnp.ndarray  # (N, 8) encoded
-    tri_flat: jnp.ndarray  # (T*9,) leaf-order triangle vertices, flattened
 
 
 def _area(bmin, bmax):
@@ -56,8 +57,12 @@ def _area(bmin, bmax):
     return 2 * (d[0] * d[1] + d[0] * d[2] + d[1] * d[2])
 
 
-def build_wide(bvh: BVHArrays, tri_verts_leaf_order: np.ndarray) -> WideBVH:
-    """Collapse the flattened binary BVH into 8-wide nodes (host)."""
+def build_wide(bvh: BVHArrays) -> WideBVH:
+    """Collapse the flattened binary BVH into 8-wide nodes (host).
+
+    Leaf triangle data is NOT duplicated here: traversal slices the shared
+    leaf-order triangle array (`pad_tri_verts` of it) that the scene
+    compiler uploads once for both traversal and interaction lookup."""
     n_prims_b = bvh.n_prims
     second = bvh.second_child
     bmin_b = bvh.bounds_min
@@ -66,6 +71,15 @@ def build_wide(bvh: BVHArrays, tri_verts_leaf_order: np.ndarray) -> WideBVH:
 
     def leaf_code(b):
         return -(1 + int(off_b[b]) * _LEAF_STRIDE + int(n_prims_b[b]))
+
+    def is_interior(b):
+        # the Morton builder pads its complete tree with empty leaves
+        # (n_prims == 0, second == 0, inf/-inf bounds); only a forward
+        # second-child pointer marks a real interior node
+        return n_prims_b[b] == 0 and int(second[b]) > b
+
+    def is_empty_leaf(b):
+        return n_prims_b[b] == 0 and int(second[b]) <= b
 
     wide_nodes = []  # each: list of (binary node id or leaf-code, bmin, bmax)
     # map binary node id -> wide node id (filled as we emit)
@@ -89,7 +103,7 @@ def build_wide(bvh: BVHArrays, tri_verts_leaf_order: np.ndarray) -> WideBVH:
                 best = -1
                 best_a = -1.0
                 for i, sb in enumerate(slots):
-                    if n_prims_b[sb] == 0:  # interior
+                    if is_interior(sb):
                         a = _area(bmin_b[sb], bmax_b[sb])
                         if a > best_a:
                             best_a = a
@@ -101,6 +115,8 @@ def build_wide(bvh: BVHArrays, tri_verts_leaf_order: np.ndarray) -> WideBVH:
                 slots.append(int(second[sb]))
             children = []
             for sb in slots:
+                if is_empty_leaf(sb):
+                    continue  # unhittable padding: no slot at all
                 if n_prims_b[sb] > 0:
                     children.append((leaf_code(sb), bmin_b[sb], bmax_b[sb]))
                 else:
@@ -123,16 +139,36 @@ def build_wide(bvh: BVHArrays, tri_verts_leaf_order: np.ndarray) -> WideBVH:
             cmin[i, k] = bmn
             cmax[i, k] = bmx
 
-    tv = np.ascontiguousarray(tri_verts_leaf_order, dtype=np.float32)
-    # pad so the fixed-size leaf slice never reads past the end
-    pad = MAX_LEAF_PRIMS
-    tv = np.concatenate([tv, np.zeros((pad, 3, 3), np.float32)], axis=0)
+    # Loud stack check (replaces a silent top-slot clamp): children always
+    # get larger wide ids than their parent, so a reverse pass computes
+    # interior depth; each interior pop frees 1 slot and pushes <= WIDTH,
+    # giving worst-case occupancy (WIDTH-1)*depth + 1.
+    depth = np.ones(n, np.int64)
+    for i in range(n - 1, -1, -1):
+        for code, _, _ in wide_nodes[i]:
+            if code >= 0:
+                depth[i] = max(depth[i], 1 + depth[code])
+    worst = (WIDTH - 1) * int(depth[0]) + 1
+    if worst > MAX_STACK:
+        raise ValueError(
+            f"wide BVH depth {int(depth[0])} needs stack {worst} > MAX_STACK="
+            f"{MAX_STACK}; raise MAX_STACK in accel/wide.py"
+        )
+
     return WideBVH(
         child_bmin=jnp.asarray(cmin),
         child_bmax=jnp.asarray(cmax),
         child_idx=jnp.asarray(cidx),
-        tri_flat=jnp.asarray(tv.reshape(-1)),
     )
+
+
+def pad_tri_verts(tri_verts_leaf_order: np.ndarray) -> np.ndarray:
+    """Pad the leaf-order (T,3,3) vertex array with MAX_LEAF_PRIMS zero rows
+    so the fixed-size leaf dynamic_slice never reads past the end. The
+    padded rows are degenerate triangles (det == 0 -> never hit), so the
+    same array safely serves brute-force oracles and interaction gathers."""
+    tv = np.ascontiguousarray(tri_verts_leaf_order, dtype=np.float32)
+    return np.concatenate([tv, np.zeros((MAX_LEAF_PRIMS, 3, 3), np.float32)], axis=0)
 
 
 # -------------------------------------------------------------------------
@@ -152,7 +188,7 @@ class _WState(NamedTuple):
 _MAX_ITERS = 16384  # safety bound; real traversals finish in hundreds
 
 
-def _ray_traverse_wide(w: WideBVH, o, d, t_max, any_hit: bool):
+def _ray_traverse_wide(w: WideBVH, tri_flat, o, d, t_max, any_hit: bool):
     inv_d = 1.0 / d
 
     def cond(s: _WState):
@@ -168,7 +204,7 @@ def _ray_traverse_wide(w: WideBVH, o, d, t_max, any_hit: bool):
         off = jnp.where(is_leaf, leaf_dec // _LEAF_STRIDE, 0)
         cnt = jnp.where(is_leaf, leaf_dec % _LEAF_STRIDE, 0)
         tri_block = jax.lax.dynamic_slice(
-            w.tri_flat, (off * 9,), (MAX_LEAF_PRIMS * 9,)
+            tri_flat, (off * 9,), (MAX_LEAF_PRIMS * 9,)
         ).reshape(MAX_LEAF_PRIMS, 3, 3)
         h, th, b0h, b1h = intersect_triangle(
             o, d, tri_block[:, 0], tri_block[:, 1], tri_block[:, 2], s.t
@@ -200,13 +236,15 @@ def _ray_traverse_wide(w: WideBVH, o, d, t_max, any_hit: bool):
         # push far-to-near so near children pop first
         key = jnp.where(hit8, tn, -jnp.inf)
         order = jnp.argsort(key)  # misses (-inf) first, then near..far
+        # stack depth is validated loudly at build time (build_wide), so the
+        # push needs no runtime clamp
         stack = s.stack
         sp_new = sp
         for j in range(WIDTH - 1, -1, -1):  # far .. near
             c = order[j]
             do = hit8[c]
             stack = jnp.where(do, stack.at[sp_new].set(cids[c]), stack)
-            sp_new = jnp.where(do, jnp.minimum(sp_new + 1, MAX_STACK - 1), sp_new)
+            sp_new = jnp.where(do, sp_new + 1, sp_new)
 
         done_early = jnp.where(any_hit & (prim_new >= 0), jnp.int32(0), sp_new)
         return _WState(done_early, stack, t_new, prim_new, b0_new, b1_new, s.iters + 1)
@@ -225,15 +263,18 @@ def _ray_traverse_wide(w: WideBVH, o, d, t_max, any_hit: bool):
 
 
 @jax.jit
-def wide_intersect(w: WideBVH, o, d, t_max) -> Hit:
-    """Closest-hit over a ray batch against the wide BVH."""
+def wide_intersect(w: WideBVH, tri_verts, o, d, t_max) -> Hit:
+    """Closest-hit over a ray batch against the wide BVH. tri_verts is the
+    shared padded leaf-order vertex array (see pad_tri_verts)."""
     t_max = jnp.broadcast_to(jnp.asarray(t_max, jnp.float32), o.shape[:-1])
-    return jax.vmap(lambda oo, dd, tt: _ray_traverse_wide(w, oo, dd, tt, False))(o, d, t_max)
+    tri_flat = tri_verts.reshape(-1)
+    return jax.vmap(lambda oo, dd, tt: _ray_traverse_wide(w, tri_flat, oo, dd, tt, False))(o, d, t_max)
 
 
 @jax.jit
-def wide_intersect_p(w: WideBVH, o, d, t_max) -> jnp.ndarray:
+def wide_intersect_p(w: WideBVH, tri_verts, o, d, t_max) -> jnp.ndarray:
     """Any-hit (shadow) predicate over a ray batch."""
     t_max = jnp.broadcast_to(jnp.asarray(t_max, jnp.float32), o.shape[:-1])
-    hit = jax.vmap(lambda oo, dd, tt: _ray_traverse_wide(w, oo, dd, tt, True))(o, d, t_max)
+    tri_flat = tri_verts.reshape(-1)
+    hit = jax.vmap(lambda oo, dd, tt: _ray_traverse_wide(w, tri_flat, oo, dd, tt, True))(o, d, t_max)
     return hit.prim >= 0

@@ -37,19 +37,6 @@ from tpu_pbrt.accel.traverse import (
 from tpu_pbrt.accel.wide import wide_intersect, wide_intersect_p
 
 
-def scene_intersect(dev, o, d, t_max) -> Hit:
-    """Scene::Intersect — dispatches to the wide-BVH kernel when the scene
-    compiler provides one (the TPU-shaped default), else the binary walk."""
-    if "wbvh" in dev:
-        return wide_intersect(dev["wbvh"], o, d, t_max)
-    return scene_intersect(dev, o, d, t_max)
-
-
-def scene_intersect_p(dev, o, d, t_max):
-    """Scene::IntersectP — shadow-ray predicate."""
-    if "wbvh" in dev:
-        return wide_intersect_p(dev["wbvh"], o, d, t_max)
-    return scene_intersect_p(dev, o, d, t_max)
 from tpu_pbrt.cameras import generate_rays
 from tpu_pbrt.core import bxdf
 from tpu_pbrt.core import lights_dev as ld
@@ -66,6 +53,21 @@ from tpu_pbrt.core.vecmath import (
     to_local,
     to_world,
 )
+
+def scene_intersect(dev, o, d, t_max) -> Hit:
+    """Scene::Intersect — dispatches to the wide-BVH kernel when the scene
+    compiler provides one (the TPU-shaped default), else the binary walk."""
+    if "wbvh" in dev:
+        return wide_intersect(dev["wbvh"], dev["tri_verts"], o, d, t_max)
+    return bvh_intersect(dev["bvh"], dev["tri_verts"], o, d, t_max)
+
+
+def scene_intersect_p(dev, o, d, t_max):
+    """Scene::IntersectP — shadow-ray predicate."""
+    if "wbvh" in dev:
+        return wide_intersect_p(dev["wbvh"], dev["tri_verts"], o, d, t_max)
+    return bvh_intersect_p(dev["bvh"], dev["tri_verts"], o, d, t_max)
+
 
 # dimension salts (one stream per logical sampler dimension; bounce-shifted)
 DIM_FILM_X = 0
@@ -169,9 +171,7 @@ def estimate_direct(dev, light_distr, it: Interaction, mp, px, py, s, bounce, li
     )
     # shadow ray
     o_s = offset_ray_origin(it.p, it.ng, ls.wi)
-    occluded = bvh_intersect_p(
-        dev["bvh"], dev["tri_verts"], o_s, ls.wi, ls.dist * 0.999
-    )
+    occluded = scene_intersect_p(dev, o_s, ls.wi, ls.dist * 0.999)
     vis = do_light & ~occluded
     w_light = jnp.where(ls.is_delta, 1.0, power_heuristic(1.0, ls.pdf, 1.0, bsdf_pdf))
     contrib_l = f * ls.li * (w_light / jnp.maximum(ls.pdf, 1e-20))[..., None]
