@@ -1,14 +1,20 @@
 #!/usr/bin/env python
 """Benchmark driver: renders the killeroo-simple-class workload and prints
-one JSON line {"metric", "value", "unit", "vs_baseline"}.
+one JSON line {"metric", "value", "unit", "vs_baseline", ...}.
 
 The workload mirrors BASELINE.json's killeroo-simple config (PathIntegrator,
 matte trimesh, area light) with a procedural ~128k-triangle mesh standing in
-for the PLY (pbrt-v3-scenes is not available in this environment). Metric is
-Mray/s (rays actually traced / steady-state wall time, counted in-kernel),
-judged against the north-star 100 Mray/s target. A warmup pass excludes XLA
-compilation from the timing, matching how the reference's numbers would
-exclude its BVH build.
+for the PLY (pbrt-v3-scenes is not available in this environment).
+
+Metrics (the judged pair, BASELINE.json `metric`):
+- Mray/s: rays actually traced / steady-state wall time, counted in-kernel.
+  A warmup pass excludes XLA compilation from the timing, matching how the
+  reference's numbers would exclude its BVH build.
+- mse: per-pixel MSE of an accelerator render vs the cached CPU reference
+  image (tools/make_reference.py; refimg/). Target <= 1e-4.
+
+Env knobs: BENCH_SPP/BENCH_RES (throughput run), MSE_RES/MSE_SPP/REF_SPP
+(accuracy run), BENCH_SKIP_MSE=1 to skip the accuracy half.
 """
 
 import json
@@ -16,6 +22,27 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def compute_mse(mse_res: int, mse_spp: int, ref_spp: int):
+    """Accelerator render vs cached CPU reference -> per-pixel MSE, or None
+    if the reference cache is missing (generate with tools/make_reference.py)."""
+    import numpy as np
+
+    from tools.make_reference import reference_path
+
+    path = reference_path(mse_res, ref_spp)
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as z:
+        ref = np.asarray(z["image"], np.float32)
+
+    from tpu_pbrt.scenes import compile_api, make_killeroo_like
+
+    api = make_killeroo_like(res=mse_res, spp=mse_spp)
+    scene, integ = compile_api(api)
+    img = np.asarray(integ.render(scene).image, np.float32)
+    return float(np.mean((img - ref) ** 2))
 
 
 def main():
@@ -30,17 +57,29 @@ def main():
     # warmup run with identical shapes so the timed run hits the jit cache
     integ.render(scene)
     result = integ.render(scene)
+
+    mse = None
+    if not os.environ.get("BENCH_SKIP_MSE"):
+        try:
+            mse = compute_mse(
+                int(os.environ.get("MSE_RES", "128")),
+                int(os.environ.get("MSE_SPP", "256")),
+                int(os.environ.get("REF_SPP", "256")),
+            )
+        except Exception as e:  # noqa: BLE001 — MSE failure must not eat the perf number
+            print(f"mse computation failed: {e}", file=sys.stderr)
+
     north_star = 100.0  # Mray/s on v5e-8 (BASELINE.json north_star)
-    print(
-        json.dumps(
-            {
-                "metric": "killeroo_like_path_mray_per_sec",
-                "value": round(result.mray_per_sec, 3),
-                "unit": "Mray/s",
-                "vs_baseline": round(result.mray_per_sec / north_star, 4),
-            }
-        )
-    )
+    line = {
+        "metric": "killeroo_like_path_mray_per_sec",
+        "value": round(result.mray_per_sec, 3),
+        "unit": "Mray/s",
+        "vs_baseline": round(result.mray_per_sec / north_star, 4),
+    }
+    if mse is not None:
+        line["mse_vs_cpu_ref"] = mse
+        line["mse_target"] = 1e-4
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -76,3 +76,26 @@ class TestStats:
         for _ in range(10):
             p.update()
         p.done()
+
+
+class TestCheckpointFingerprint:
+    def test_mismatched_config_rejected(self, tmp_path):
+        """A checkpoint written under one (chunk, spp, scene) configuration
+        must refuse to resume under another instead of silently corrupting
+        the image (ADVICE r1)."""
+        import jax.numpy as jnp
+        import pytest
+
+        from tpu_pbrt.core.film import FilmState
+
+        st = FilmState(
+            rgb=jnp.zeros((4, 4, 3)), weight=jnp.zeros((4, 4)), splat=jnp.zeros((4, 4, 3))
+        )
+        p = str(tmp_path / "ck.npz")
+        save_checkpoint(p, st, 3, 100, fingerprint="chunk=1024;spp=8")
+        # same fingerprint resumes
+        _, nxt, rays = load_checkpoint(p, "chunk=1024;spp=8")
+        assert (nxt, rays) == (3, 100)
+        # different fingerprint is refused
+        with pytest.raises(ValueError, match="different render configuration"):
+            load_checkpoint(p, "chunk=2048;spp=8")

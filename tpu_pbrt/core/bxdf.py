@@ -411,11 +411,15 @@ def bsdf_sample(mp: MatParams, wo, u_lobe, u1, u2) -> BSDFSample:
     pick_g = has_g & ((~has_d) | (u_lobe * n_lobes.astype(jnp.float32) >= 1.0))
 
     # --- diffuse candidate (cosine hemisphere) ---------------------------
-    wi_d = cosine_sample_hemisphere(u1, u2)
-    wi_d = jnp.where((cos_theta(wo) < 0.0)[..., None], wi_d * jnp.asarray([1.0, 1.0, -1.0]), wi_d)
-    # translucent: u_lobe also chooses hemisphere (reflect/transmit)
+    # translucent: u2's low bit picks reflect/transmit, then u2 is remapped
+    # to [0,1) so the decision and the disk coordinate are independent —
+    # reusing raw u2 for both would cover only half the transmitted disk
+    # while _diffuse_pdf claims the full hemisphere (ADVICE r1)
     is_transl = mp.mtype == MAT_TRANSLUCENT
-    flip_t = is_transl & (u2 < 0.5)  # reuse u2 high bits is fine statistically
+    flip_t = is_transl & (u2 < 0.5)
+    u2d = jnp.where(is_transl, jnp.where(u2 < 0.5, 2.0 * u2, 2.0 * (u2 - 0.5)), u2)
+    wi_d = cosine_sample_hemisphere(u1, u2d)
+    wi_d = jnp.where((cos_theta(wo) < 0.0)[..., None], wi_d * jnp.asarray([1.0, 1.0, -1.0]), wi_d)
     wi_d = jnp.where(flip_t[..., None], wi_d * jnp.asarray([1.0, 1.0, -1.0]), wi_d)
 
     # --- glossy candidate (VNDF half-vector) -----------------------------

@@ -41,7 +41,11 @@ from tpu_pbrt.cameras import generate_rays
 from tpu_pbrt.core import bxdf
 from tpu_pbrt.core import lights_dev as ld
 from tpu_pbrt.core.film import FilmState
-from tpu_pbrt.parallel.checkpoint import load_checkpoint, save_checkpoint
+from tpu_pbrt.parallel.checkpoint import (
+    load_checkpoint,
+    render_fingerprint,
+    save_checkpoint,
+)
 from tpu_pbrt.core.sampling import hash_u32, power_heuristic, sobol_2d, uniform_float
 from tpu_pbrt.core.vecmath import (
     coordinate_system,
@@ -67,6 +71,70 @@ def scene_intersect_p(dev, o, d, t_max):
     if "wbvh" in dev:
         return wide_intersect_p(dev["wbvh"], dev["tri_verts"], o, d, t_max)
     return bvh_intersect_p(dev["bvh"], dev["tri_verts"], o, d, t_max)
+
+
+def unoccluded_tr(dev, o, d, dist, cur_med, px, py, s, salt, segments=1):
+    """VisibilityTester::Unoccluded/Tr (light.cpp): is the light sample
+    visible, and with what transmittance?
+
+    pbrt's Tr walk passes THROUGH null-BSDF surfaces (medium-interface
+    container geometry), accumulating each sub-segment's medium
+    transmittance and switching media at the crossing; only real-material
+    hits occlude (ADVICE r1: MAT_NONE shapes must not block in-medium NEE).
+
+    segments=1 is the cheap any-hit path for scenes with no null materials.
+    cur_med None skips transmittance entirely (no media in flight).
+    Returns (visible (R,), tr (R,3))."""
+    from tpu_pbrt.core import media as md
+    from tpu_pbrt.scene.compiler import MAT_NONE
+
+    shape = o.shape[:-1]
+    tr = jnp.ones(shape + (3,), jnp.float32)
+    remaining = jnp.broadcast_to(jnp.asarray(dist, jnp.float32), shape) * 0.999
+    mt = dev.get("media") if cur_med is not None else None
+
+    if segments == 1:
+        occluded = scene_intersect_p(dev, o, d, remaining)
+        if mt is not None:
+            med = jnp.where(~occluded, jnp.broadcast_to(cur_med, shape), -1)
+            tr = md.medium_tr(mt, med, o, d, remaining, px, py, s, salt)
+        return ~occluded, tr
+
+    med = (
+        jnp.broadcast_to(jnp.asarray(cur_med, jnp.int32), shape)
+        if cur_med is not None
+        else jnp.full(shape, -1, jnp.int32)
+    )
+    oo = o
+    visible = jnp.zeros(shape, bool)
+    active = jnp.ones(shape, bool)
+    for k in range(segments):
+        hit = scene_intersect(dev, oo, d, remaining)
+        hit_any = active & (hit.prim >= 0)
+        prim = jnp.maximum(hit.prim, 0)
+        # tri_mat holds material-table indices; the null test is on the type
+        is_null = hit_any & (dev["mat"]["type"][dev["tri_mat"][prim]] == MAT_NONE)
+        seg_len = jnp.where(hit_any, hit.t, remaining)
+        if mt is not None:
+            tr_seg = md.medium_tr(
+                mt, jnp.where(active, med, -1), oo, d, seg_len, px, py, s, salt + 7 * k
+            )
+            tr = jnp.where(active[..., None], tr * tr_seg, tr)
+        visible = visible | (active & ~hit_any)
+        # step past null interfaces, flipping the medium at the crossing
+        step = is_null
+        tv = dev["tri_verts"][prim]
+        ng = normalize(cross(tv[..., 1, :] - tv[..., 0, :], tv[..., 2, :] - tv[..., 0, :]))
+        going_in = dot(d, ng) < 0.0
+        new_med = jnp.where(going_in, dev["tri_med_in"][prim], dev["tri_med_out"][prim])
+        med = jnp.where(step, new_med, med)
+        p_hit = oo + hit.t[..., None] * d
+        oo = jnp.where(step[..., None], offset_ray_origin(p_hit, ng, d), oo)
+        remaining = jnp.where(step, remaining - hit.t, remaining)
+        active = step
+    # lanes that ran out of segments while still inside null nesting count
+    # as occluded (conservative; PASSTHROUGH_MARGIN bounds real scenes)
+    return visible, tr
 
 
 # dimension salts (one stream per logical sampler dimension; bounce-shifted)
@@ -144,14 +212,18 @@ def make_interaction(dev, hit: Hit, o, d) -> Interaction:
     )
 
 
-def estimate_direct(dev, light_distr, it: Interaction, mp, px, py, s, bounce, light_idx=None, salt_extra=0):
+def estimate_direct(
+    dev, light_distr, it: Interaction, mp, px, py, s, bounce,
+    light_idx=None, salt_extra=0, vis_segments=1,
+):
     """pbrt EstimateDirect with MIS, light-sampling half + BSDF-sampling
     half. Traces one shadow ray and (for the BSDF half) one MIS ray.
 
     light_idx None -> UniformSampleOneLight semantics (random light, pick
     pmf folded into the pdf). light_idx (R,) -> EstimateDirect against that
     specific light (UniformSampleAllLights loops this over every light).
-    Returns (R,3) direct radiance at the interaction."""
+    vis_segments > 1 makes the shadow walk pass through MAT_NONE container
+    geometry (see unoccluded_tr). Returns (R,3) direct radiance."""
     salt = bounce * DIMS_PER_BOUNCE + salt_extra
 
     # ---- light-sampling half -------------------------------------------
@@ -171,8 +243,11 @@ def estimate_direct(dev, light_distr, it: Interaction, mp, px, py, s, bounce, li
     )
     # shadow ray
     o_s = offset_ray_origin(it.p, it.ng, ls.wi)
-    occluded = scene_intersect_p(dev, o_s, ls.wi, ls.dist * 0.999)
-    vis = do_light & ~occluded
+    visible, _ = unoccluded_tr(
+        dev, o_s, ls.wi, jnp.where(do_light, ls.dist, -1.0), None,
+        px, py, s, salt + DIM_LIGHT_UV + 300, segments=vis_segments,
+    )
+    vis = do_light & visible
     w_light = jnp.where(ls.is_delta, 1.0, power_heuristic(1.0, ls.pdf, 1.0, bsdf_pdf))
     contrib_l = f * ls.li * (w_light / jnp.maximum(ls.pdf, 1e-20))[..., None]
     L = jnp.where(vis[..., None], contrib_l, 0.0)
@@ -251,6 +326,10 @@ class WavefrontIntegrator:
         # "uniform" -> None; "power"/"spatial" -> power distribution (the
         # voxel-hashed SpatialLightDistribution falls back to power here)
         self.light_distr = None if strategy == "uniform" else scene.light_distr
+        # shadow rays must pass through MAT_NONE container geometry (pbrt
+        # VisibilityTester); pay the multi-segment walk only when the scene
+        # actually has null interfaces
+        self.vis_segments = 4 if scene.has_null_materials else 1
 
     # -- subclass hook ----------------------------------------------------
     def li(self, dev, o, d, px, py, s):
@@ -282,7 +361,14 @@ class WavefrontIntegrator:
         n_dev = 1 if mesh is None else mesh.devices.size
         import os as _os
 
-        chunk = int(_os.environ.get("TPU_PBRT_CHUNK", min(MAX_RAYS_PER_DISPATCH >> 1, 1 << 17)))
+        # Default chunk: on the axon-tunneled TPU a single dispatch must
+        # stay under the tunnel's wall-clock watchdog (~60-90 s kills the
+        # worker), which at current kernel throughput means <= 8k camera
+        # rays per dispatch; CPU (tests) has no such limit and prefers
+        # fewer, larger dispatches.
+        is_tpu = jax.devices()[0].platform != "cpu"
+        default_chunk = (1 << 13) if is_tpu else min(MAX_RAYS_PER_DISPATCH >> 1, 1 << 17)
+        chunk = int(_os.environ.get("TPU_PBRT_CHUNK", default_chunk))
         chunk = min(chunk, max(1024 * n_dev, total))
         chunk = (chunk // n_dev) * n_dev
         per_dev = chunk // n_dev
@@ -365,8 +451,9 @@ class WavefrontIntegrator:
         first_chunk = 0
         prev_rays = 0
         state = film.init_state()
+        fp = render_fingerprint(chunk=chunk, spp=spp, total=total, scene=scene)
         if ckpt_path and _os.path.exists(ckpt_path):
-            state, first_chunk, prev_rays = load_checkpoint(ckpt_path)
+            state, first_chunk, prev_rays = load_checkpoint(ckpt_path, fp)
 
         quiet = bool(getattr(self.options, "quiet", False))
         progress = ProgressReporter(n_chunks, "Rendering", quiet=quiet)
@@ -383,7 +470,11 @@ class WavefrontIntegrator:
                 progress.update()
                 if ckpt_path and checkpoint_every and (c + 1) % checkpoint_every == 0:
                     save_checkpoint(
-                        ckpt_path, state, c + 1, prev_rays + sum(int(r) for r in ray_counts)
+                        ckpt_path,
+                        state,
+                        c + 1,
+                        prev_rays + sum(int(r) for r in ray_counts),
+                        fingerprint=fp,
                     )
             jax.block_until_ready(state)
         secs = time.time() - t0
@@ -393,7 +484,7 @@ class WavefrontIntegrator:
         STATS.counter("Integrator/Camera rays traced", total)
         STATS.distribution("Integrator/Rays per camera ray", rays / max(total, 1))
         if ckpt_path:
-            save_checkpoint(ckpt_path, state, n_chunks, rays)
+            save_checkpoint(ckpt_path, state, n_chunks, rays, fingerprint=fp)
         img = film.develop(state)
         if film.filename:
             try:

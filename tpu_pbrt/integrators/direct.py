@@ -77,11 +77,15 @@ class DirectLightingIntegrator(WavefrontIntegrator):
                     Ld = estimate_direct(
                         dev, self.light_distr, it, mp, px, py, s,
                         depth, light_idx=idx, salt_extra=li_i * 1000,
+                        vis_segments=self.vis_segments,
                     )
                     L = L + jnp.where(it.valid[..., None], beta * Ld, 0.0)
                     nrays = nrays + 2 * it.valid.astype(jnp.int32)
             else:
-                Ld = estimate_direct(dev, self.light_distr, it, mp, px, py, s, depth)
+                Ld = estimate_direct(
+                    dev, self.light_distr, it, mp, px, py, s, depth,
+                    vis_segments=self.vis_segments,
+                )
                 L = L + jnp.where(it.valid[..., None], beta * Ld, 0.0)
                 nrays = nrays + 2 * it.valid.astype(jnp.int32)
 

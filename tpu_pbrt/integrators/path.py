@@ -6,17 +6,23 @@ NEE with MIS, BSDF importance sampling for the continuation, beta updates,
 and Russian roulette after depth 3 with the eta^2 radiance correction.
 
 TPU-first redesign (SURVEY.md §7): the per-ray recursion becomes a
-wavefront — the whole ray batch advances one bounce per stage under a live
-mask, with all control flow as masked selects. The MIS bookkeeping uses the
-forward formulation (pbrt-v4 style): instead of EstimateDirect's extra
-BSDF-MIS shadow ray per bounce, the continuation ray itself carries the
-BSDF pdf, and emitters hit by it are weighted by
-power_heuristic(bsdf_pdf, light_pdf). Identical expectation to the
-reference estimator, one ray cheaper per bounce.
+wavefront — the whole ray batch advances one bounce per `lax.while_loop`
+iteration under a live mask, with all control flow as masked selects. One
+compiled bounce body serves every depth (compile time and program size are
+constant in maxdepth — a Python-unrolled loop at production depth
+overflowed the XLA program budget), and the loop exits as soon as every
+lane is dead. The MIS bookkeeping uses the forward formulation (pbrt-v4
+style): instead of EstimateDirect's extra BSDF-MIS shadow ray per bounce,
+the continuation ray itself carries the BSDF pdf, and emitters hit by it
+are weighted by power_heuristic(bsdf_pdf, light_pdf). Identical
+expectation to the reference estimator, one ray cheaper per bounce.
 """
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
+import jax
 import jax.numpy as jnp
 
 from tpu_pbrt.core import bxdf
@@ -26,6 +32,7 @@ from tpu_pbrt.core.vecmath import dot, normalize, offset_ray_origin, to_local, t
 from tpu_pbrt.integrators.common import (
     scene_intersect,
     scene_intersect_p,
+    unoccluded_tr,
     DIM_BSDF_LOBE,
     DIM_BSDF_UV,
     DIM_LIGHT_PICK,
@@ -35,6 +42,9 @@ from tpu_pbrt.integrators.common import (
     WavefrontIntegrator,
     make_interaction,
 )
+from tpu_pbrt.scene.compiler import MAT_NONE
+
+PASSTHROUGH_MARGIN = 4
 
 
 class PathIntegrator(WavefrontIntegrator):
@@ -44,22 +54,44 @@ class PathIntegrator(WavefrontIntegrator):
         super().__init__(params, scene, options)
         self.max_depth = params.find_one_int("maxdepth", 5)
         self.rr_threshold = params.find_one_float("rrthreshold", 1.0)
+        # null-BSDF (interface/container) surfaces: pbrt spawns through them
+        # without counting a bounce (path.cpp bounces--). The wavefront
+        # equivalent is extra loop iterations + a per-lane real-bounce
+        # counter; scenes without null materials pay nothing (ADVICE r1).
+        self.margin = PASSTHROUGH_MARGIN if scene.has_null_materials else 0
 
     def li(self, dev, o, d, px, py, s):
         shape = o.shape[:-1]
-        L = jnp.zeros(shape + (3,), jnp.float32)
-        beta = jnp.ones(shape + (3,), jnp.float32)
-        alive = jnp.ones(shape, bool)
-        nrays = jnp.zeros(shape, jnp.int32)
-        # MIS state: pdf of the BSDF sample that produced the current ray,
-        # and whether it was specular (then emitters count in full)
-        prev_pdf = jnp.zeros(shape, jnp.float32)
-        specular = jnp.ones(shape, bool)  # camera "bounce" counts as specular
-        eta_scale = jnp.ones(shape, jnp.float32)
-        prev_p = o  # previous path vertex (for light pdf conversion)
+        max_iters = self.max_depth + 1 + self.margin
 
-        for bounce in range(self.max_depth + 1):
-            hit = scene_intersect(dev, o, d, jnp.inf)
+        class St(NamedTuple):
+            bounce: jnp.ndarray  # scalar: loop iteration (= sampler salt base)
+            o: jnp.ndarray
+            d: jnp.ndarray
+            L: jnp.ndarray
+            beta: jnp.ndarray
+            alive: jnp.ndarray
+            nrays: jnp.ndarray
+            depth: jnp.ndarray  # per-lane real (non-null) bounces taken
+            prev_pdf: jnp.ndarray
+            specular: jnp.ndarray
+            eta_scale: jnp.ndarray
+            prev_p: jnp.ndarray
+
+        def cond(st: St):
+            return (st.bounce < max_iters) & jnp.any(st.alive)
+
+        def body(st: St):
+            bounce = st.bounce
+            salt = bounce * DIMS_PER_BOUNCE
+            o, d, L, beta, alive = st.o, st.d, st.L, st.beta, st.alive
+            depth, prev_pdf, specular = st.depth, st.prev_pdf, st.specular
+            eta_scale, prev_p, nrays = st.eta_scale, st.prev_p, st.nrays
+
+            # dead lanes traverse with t_max < 0: the root slab test fails
+            # immediately, so they cost one loop iteration, not a walk
+            t_max = jnp.where(alive, jnp.inf, -1.0)
+            hit = scene_intersect(dev, o, d, t_max)
             nrays = nrays + alive.astype(jnp.int32)
             it = make_interaction(dev, hit, o, d)
             it.valid = it.valid & alive
@@ -80,12 +112,13 @@ class PathIntegrator(WavefrontIntegrator):
             L = L + beta * le * w_emit[..., None]
 
             alive = alive & (hit.prim >= 0)
-            if bounce >= self.max_depth:
-                break
+            # pbrt: the vertex at bounces == maxDepth emits but neither
+            # samples lights nor continues
+            can_scatter = depth < self.max_depth
 
             # ---- NEE: light-sampling half only --------------------------
             mp = bxdf.gather_mat(dev["mat"], it.mat)
-            salt = bounce * DIMS_PER_BOUNCE
+            is_null = it.valid & (mp.mtype == MAT_NONE) if self.margin else None
             u_pick = uniform_float(px, py, s, salt + DIM_LIGHT_PICK)
             u1 = uniform_float(px, py, s, salt + DIM_LIGHT_UV)
             u2 = uniform_float(px, py, s, salt + DIM_LIGHT_UV + 100)
@@ -96,16 +129,21 @@ class PathIntegrator(WavefrontIntegrator):
             f = f * jnp.abs(dot(ls.wi, it.ns))[..., None]
             do_nee = (
                 it.valid
+                & can_scatter
                 & (ls.pdf > 0.0)
                 & (jnp.max(f, axis=-1) > 0.0)
                 & (jnp.max(ls.li, axis=-1) > 0.0)
             )
             o_sh = offset_ray_origin(it.p, it.ng, ls.wi)
-            occluded = scene_intersect_p(dev, o_sh, ls.wi, ls.dist * 0.999)
+            sh_dist = jnp.where(do_nee, ls.dist, -1.0)  # fast-exit dead lanes
+            visible, _ = unoccluded_tr(
+                dev, o_sh, ls.wi, sh_dist, None, px, py, s, salt + DIM_LIGHT_UV + 200,
+                segments=self.vis_segments,
+            )
             nrays = nrays + do_nee.astype(jnp.int32)
             w_l = jnp.where(ls.is_delta, 1.0, power_heuristic(1.0, ls.pdf, 1.0, bsdf_pdf))
             Ld = f * ls.li * (w_l / jnp.maximum(ls.pdf, 1e-20))[..., None]
-            L = L + jnp.where((do_nee & ~occluded)[..., None], beta * Ld, 0.0)
+            L = L + jnp.where((do_nee & visible)[..., None], beta * Ld, 0.0)
 
             # ---- continuation: BSDF sample ------------------------------
             ul = uniform_float(px, py, s, salt + DIM_BSDF_LOBE)
@@ -113,7 +151,7 @@ class PathIntegrator(WavefrontIntegrator):
             ub2 = uniform_float(px, py, s, salt + DIM_BSDF_UV + 100)
             bs = bxdf.bsdf_sample(mp, wo_l, ul, ub1, ub2)
             wi_w = normalize(to_world(bs.wi, it.ss, it.ts, it.ns))
-            cont = it.valid & (bs.pdf > 0.0) & (jnp.max(bs.f, axis=-1) > 0.0)
+            cont = it.valid & can_scatter & (bs.pdf > 0.0) & (jnp.max(bs.f, axis=-1) > 0.0)
             throughput = bs.f * (jnp.abs(dot(wi_w, it.ns)) / jnp.maximum(bs.pdf, 1e-20))[..., None]
             beta = jnp.where(cont[..., None], beta * throughput, beta)
             # eta^2 tracking for RR (path.cpp etaScale)
@@ -127,19 +165,50 @@ class PathIntegrator(WavefrontIntegrator):
             d = jnp.where(cont[..., None], wi_w, d)
             prev_pdf = jnp.where(cont, bs.pdf, prev_pdf)
             specular = jnp.where(cont, bs.is_specular, specular)
+            depth = depth + cont.astype(jnp.int32)
             alive = cont
 
-            # ---- Russian roulette (after bounce 3) ----------------------
-            if bounce > 3:
-                rr_beta = jnp.max(beta, axis=-1) * eta_scale
-                q = jnp.maximum(0.05, 1.0 - rr_beta)
-                u_rr = uniform_float(px, py, s, salt + DIM_RR)
-                kill = alive & (rr_beta < self.rr_threshold) & (u_rr < q)
-                survive_scale = jnp.where(
-                    alive & (rr_beta < self.rr_threshold) & ~kill,
-                    1.0 / jnp.maximum(1.0 - q, 1e-6),
-                    1.0,
-                )
-                beta = beta * survive_scale[..., None]
-                alive = alive & ~kill
-        return L, nrays
+            # ---- null passthrough (uncounted bounce, path.cpp bounces--)
+            if is_null is not None:
+                alive = alive | is_null
+                o = jnp.where(is_null[..., None], offset_ray_origin(it.p, it.ng, d), o)
+                # d/beta/prev_pdf/specular/prev_p unchanged: the crossing is
+                # not a scattering event; MIS still references the last real
+                # vertex
+
+            # ---- Russian roulette (after 3 REAL bounces: the per-lane
+            # depth counter, not the loop iteration — null crossings must
+            # not advance RR, matching pbrt's bounces-- semantics) --------
+            rr_on = depth > 4
+            rr_beta = jnp.max(beta, axis=-1) * eta_scale
+            q = jnp.maximum(0.05, 1.0 - rr_beta)
+            u_rr = uniform_float(px, py, s, salt + DIM_RR)
+            rr_cand = alive & rr_on & (rr_beta < self.rr_threshold)
+            kill = rr_cand & (u_rr < q)
+            survive_scale = jnp.where(rr_cand & ~kill, 1.0 / jnp.maximum(1.0 - q, 1e-6), 1.0)
+            beta = beta * survive_scale[..., None]
+            alive = alive & ~kill
+
+            return St(
+                bounce + 1, o, d, L, beta, alive, nrays, depth,
+                prev_pdf, specular, eta_scale, prev_p,
+            )
+
+        init = St(
+            bounce=jnp.int32(0),
+            o=o,
+            d=d,
+            L=jnp.zeros(shape + (3,), jnp.float32),
+            beta=jnp.ones(shape + (3,), jnp.float32),
+            alive=jnp.ones(shape, bool),
+            nrays=jnp.zeros(shape, jnp.int32),
+            depth=jnp.zeros(shape, jnp.int32),
+            # MIS state: pdf of the BSDF sample that produced the current
+            # ray; the camera "bounce" counts as specular
+            prev_pdf=jnp.zeros(shape, jnp.float32),
+            specular=jnp.ones(shape, bool),
+            eta_scale=jnp.ones(shape, jnp.float32),
+            prev_p=o,
+        )
+        out = jax.lax.while_loop(cond, body, init)
+        return out.L, out.nrays

@@ -30,6 +30,7 @@ from tpu_pbrt.core.vecmath import dot, normalize, offset_ray_origin, to_local, t
 from tpu_pbrt.integrators.common import (
     scene_intersect,
     scene_intersect_p,
+    unoccluded_tr,
     DIM_BSDF_LOBE,
     DIM_BSDF_UV,
     DIM_LIGHT_PICK,
@@ -54,6 +55,7 @@ class VolPathIntegrator(WavefrontIntegrator):
         self.max_depth = params.find_one_int("maxdepth", 5)
         self.rr_threshold = params.find_one_float("rrthreshold", 1.0)
         self.camera_medium = scene.camera_medium_id
+        self.margin = PASSTHROUGH_MARGIN if scene.has_null_materials else 0
 
     def li(self, dev, o, d, px, py, s):
         shape = o.shape[:-1]
@@ -67,8 +69,9 @@ class VolPathIntegrator(WavefrontIntegrator):
         eta_scale = jnp.ones(shape, jnp.float32)
         prev_p = o
         cur_med = jnp.full(shape, self.camera_medium, jnp.int32)
+        depth = jnp.zeros(shape, jnp.int32)  # real (non-null) bounces taken
 
-        for bounce in range(self.max_depth + 1 + PASSTHROUGH_MARGIN):
+        for bounce in range(self.max_depth + 1 + self.margin):
             salt = bounce * DIMS_PER_BOUNCE
             hit = scene_intersect(dev, o, d, jnp.inf)
             nrays = nrays + alive.astype(jnp.int32)
@@ -97,7 +100,7 @@ class VolPathIntegrator(WavefrontIntegrator):
             L = L + beta * le * w_emit[..., None]
 
             alive = in_medium | at_surface
-            if bounce >= self.max_depth + PASSTHROUGH_MARGIN:
+            if bounce >= self.max_depth + self.margin:
                 break
 
             # ---- null material passthrough (medium transition) ----------
@@ -125,21 +128,31 @@ class VolPathIntegrator(WavefrontIntegrator):
             p_phase = md.hg_p(dot(-d, ls.wi), g_hg)
             f_nee = jnp.where(in_medium[..., None], p_phase[..., None].repeat(3, -1), f_surf)
             pdf_nee_fwd = jnp.where(in_medium, p_phase, pdf_surf)
-            do_nee = (in_medium | at_surface) & (ls.pdf > 0.0) & (
+            # pbrt breaks before light sampling once bounces reach maxDepth:
+            # the final vertex emits but gets no NEE estimate
+            can_scatter = depth < self.max_depth
+            do_nee = (in_medium | at_surface) & can_scatter & (ls.pdf > 0.0) & (
                 jnp.max(f_nee, axis=-1) > 0.0
             ) & (jnp.max(ls.li, axis=-1) > 0.0)
             o_sh = jnp.where(
                 in_medium[..., None], p_medium, offset_ray_origin(it.p, it.ng, ls.wi)
             )
-            occluded = scene_intersect_p(dev, o_sh, ls.wi, ls.dist * 0.999)
-            nrays = nrays + do_nee.astype(jnp.int32)
-            # transmittance along the shadow segment through the current medium
-            tr_sh = md.medium_tr(
-                mt, jnp.where(do_nee, cur_med, -1), o_sh, ls.wi, ls.dist, px, py, s, salt + _DIM_MEDIUM + 1
+            visible, tr_sh = unoccluded_tr(
+                dev,
+                o_sh,
+                ls.wi,
+                ls.dist,
+                jnp.where(do_nee, cur_med, -1),
+                px,
+                py,
+                s,
+                salt + _DIM_MEDIUM + 1,
+                segments=self.vis_segments,
             )
+            nrays = nrays + do_nee.astype(jnp.int32)
             w_l = jnp.where(ls.is_delta, 1.0, power_heuristic(1.0, ls.pdf, 1.0, pdf_nee_fwd))
             Ld = f_nee * ls.li * tr_sh * (w_l / jnp.maximum(ls.pdf, 1e-20))[..., None]
-            L = L + jnp.where((do_nee & ~occluded)[..., None], beta * Ld, 0.0)
+            L = L + jnp.where((do_nee & visible)[..., None], beta * Ld, 0.0)
 
             # ---- continuation -------------------------------------------
             # medium: HG sample
@@ -158,7 +171,11 @@ class VolPathIntegrator(WavefrontIntegrator):
             cont_surf = at_surface & (bs.pdf > 0.0) & (jnp.max(bs.f, axis=-1) > 0.0)
             throughput = bs.f * (jnp.abs(dot(wi_surf, it.ns)) / jnp.maximum(bs.pdf, 1e-20))[..., None]
 
-            # merge the three continuation kinds: medium / surface / null
+            # merge the three continuation kinds: medium / surface / null;
+            # real scattering counts toward maxdepth, null crossings don't
+            in_medium = in_medium & can_scatter
+            cont_surf = cont_surf & can_scatter
+            depth = depth + (in_medium | cont_surf).astype(jnp.int32)
             cont = in_medium | cont_surf | is_null
             beta = jnp.where(cont_surf[..., None], beta * throughput, beta)
             new_d = jnp.where(in_medium[..., None], wi_m, wi_surf)
@@ -185,13 +202,15 @@ class VolPathIntegrator(WavefrontIntegrator):
             eta_scale = jnp.where(crossing, eta_scale * scale, eta_scale)
             alive = cont
 
-            # ---- Russian roulette ---------------------------------------
+            # ---- Russian roulette (after 3 real bounces; null crossings
+            # don't count, matching pbrt's bounces-- semantics) -----------
             if bounce > 3:
+                rr_lane = depth > 4
                 rr_beta = jnp.max(beta, axis=-1) * eta_scale
                 q = jnp.maximum(0.05, 1.0 - rr_beta)
                 u_rr = uniform_float(px, py, s, salt + DIM_RR)
-                kill = alive & (rr_beta < self.rr_threshold) & (u_rr < q)
-                survive = alive & (rr_beta < self.rr_threshold) & ~kill
+                kill = alive & rr_lane & (rr_beta < self.rr_threshold) & (u_rr < q)
+                survive = alive & rr_lane & (rr_beta < self.rr_threshold) & ~kill
                 beta = beta * jnp.where(survive, 1.0 / jnp.maximum(1.0 - q, 1e-6), 1.0)[..., None]
                 alive = alive & ~kill
         return L, nrays

@@ -16,10 +16,20 @@ import numpy as np
 
 from tpu_pbrt.core.film import FilmState
 
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 2
 
 
-def save_checkpoint(path: str, state: FilmState, next_chunk: int, rays_so_far: int):
+def save_checkpoint(
+    path: str,
+    state: FilmState,
+    next_chunk: int,
+    rays_so_far: int,
+    fingerprint: str = "",
+):
+    """fingerprint encodes everything the chunk cursor's meaning depends on
+    (chunk size, spp, work total, scene/film identity — see
+    render_fingerprint); load_checkpoint refuses a mismatch rather than
+    silently misinterpreting the cursor (ADVICE r1)."""
     tmp = path + ".tmp"
     np.savez_compressed(
         tmp if tmp.endswith(".npz") else tmp,
@@ -29,19 +39,42 @@ def save_checkpoint(path: str, state: FilmState, next_chunk: int, rays_so_far: i
         splat=np.asarray(state.splat),
         next_chunk=next_chunk,
         rays=rays_so_far,
+        fingerprint=np.array(fingerprint),
     )
     # np.savez appends .npz when missing
     actual_tmp = tmp if tmp.endswith(".npz") else tmp + ".npz"
     os.replace(actual_tmp, path)
 
 
-def load_checkpoint(path: str):
-    """-> (FilmState, next_chunk, rays_so_far)."""
+def render_fingerprint(*, chunk: int, spp: int, total: int, scene) -> str:
+    """The resume-compatibility key: chunk size depends on TPU_PBRT_CHUNK
+    and device count, spp/total on the scene spec, and the film arrays on
+    resolution — any of these changing invalidates the chunk cursor."""
+    film = scene.film
+    return (
+        f"chunk={chunk};spp={spp};total={total};tris={scene.n_tris};"
+        f"film={film.full_resolution[0]}x{film.full_resolution[1]};"
+        f"crop={film.sample_bounds()}"
+    )
+
+
+def load_checkpoint(path: str, fingerprint: str = ""):
+    """-> (FilmState, next_chunk, rays_so_far). Raises ValueError when the
+    checkpoint was written under a different render configuration."""
     import jax.numpy as jnp
 
     with np.load(path) as z:
         if int(z["version"]) != _FORMAT_VERSION:
             raise ValueError(f"checkpoint {path}: unsupported version {z['version']}")
+        saved_fp = str(z["fingerprint"].item()) if "fingerprint" in z else ""
+        # an empty saved fingerprint (hand-written or pre-metadata file)
+        # is accepted; only a conflicting one is an error
+        if fingerprint and saved_fp and saved_fp != fingerprint:
+            raise ValueError(
+                f"checkpoint {path} was written for a different render "
+                f"configuration (saved {saved_fp!r}, current {fingerprint!r}); "
+                "delete it or restore the original settings to resume"
+            )
         state = FilmState(
             rgb=jnp.asarray(z["rgb"]),
             weight=jnp.asarray(z["weight"]),

@@ -35,10 +35,10 @@ except ImportError:  # pragma: no cover
 TILE_AXIS = "tiles"
 
 
-def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     """1-D device mesh over the tile axis (a renderer's parallel axis is
     image/sample space — SURVEY.md §2f maps it to data-parallel)."""
-    devs = jax.devices()
+    devs = devices if devices is not None else jax.devices()
     n = n_devices or len(devs)
     return Mesh(np.array(devs[:n]), (TILE_AXIS,))
 

@@ -113,6 +113,9 @@ class CompiledScene:
     light_distr: Optional[Distribution1D] = None
     media: Dict[str, Any] = field(default_factory=dict)
     camera_medium_id: int = -1
+    #: scene contains MAT_NONE (interface/container) surfaces — integrators
+    #: then pay for the null-passthrough visibility walk (unoccluded_tr)
+    has_null_materials: bool = False
 
 
 # -------------------------------------------------------------------------
@@ -946,4 +949,5 @@ def compile_scene(api) -> CompiledScene:
         light_distr=light_distr,
         media=dict(ro.named_media),
         camera_medium_id=camera_medium_id,
+        has_null_materials=bool(np.any(np.asarray(mtab["type"])[np.asarray(mat_ids)] == MAT_NONE)),
     )

@@ -56,7 +56,7 @@ class StatsRegistry:
 
     def distribution(self, name: str, value):
         d = self.distributions[name]
-        d[0] += int(value)
+        d[0] += float(value)  # float sums: "rays per camera ray" is ~1.x
         d[1] += 1
         d[2] = value if d[2] is None else min(d[2], value)
         d[3] = value if d[3] is None else max(d[3], value)
