@@ -13,20 +13,44 @@ Metrics (the judged pair, BASELINE.json `metric`):
 - mse: per-pixel MSE of an accelerator render vs the cached CPU reference
   image (tools/make_reference.py; refimg/). Target <= 1e-4.
 
-Env knobs: BENCH_SPP/BENCH_RES (throughput run), MSE_RES/MSE_SPP/REF_SPP
-(accuracy run), BENCH_SKIP_MSE=1 to skip the accuracy half.
+Un-killable by design (VERDICT r2 #2): every phase is wall-clock budgeted
+(the render loop's max_seconds stops at a chunk boundary; Mray/s divides
+rays actually traced by wall time, so a partial run still measures
+steady-state throughput), MSE is attempted only if the remaining budget
+predicts it will finish, any exception prints a parseable JSON line, and
+SIGTERM reports the last completed measurement instead of dying silently.
+A driver timeout can therefore never yield `parsed: null`.
+
+Env knobs: BENCH_SPP/BENCH_RES (throughput run), BENCH_BUDGET_S (total
+wall-clock budget, default 420), MSE_RES/MSE_SPP/REF_SPP (accuracy run),
+BENCH_SKIP_MSE=1 to skip the accuracy half.
 """
 
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+T_START = time.time()
+BUDGET = float(os.environ.get("BENCH_BUDGET_S", "420"))
+
+#: last completed throughput measurement, reported by the SIGTERM/exception
+#: fallback so a mid-phase kill still lands the number we already have
+_last_line = None
+
+
+def remaining():
+    return BUDGET - (time.time() - T_START)
 
 
 def compute_mse(mse_res: int, mse_spp: int, ref_spp: int):
     """Accelerator render vs cached CPU reference -> per-pixel MSE, or None
-    if the reference cache is missing (generate with tools/make_reference.py)."""
+    if the reference cache is missing (generate with tools/make_reference.py)
+    or the budgeted render did not complete. The render budget is computed
+    AFTER the scene build/compile so that unbudgeted phase can't push the
+    total spend past BENCH_BUDGET_S."""
     import numpy as np
 
     from tools.make_reference import reference_path
@@ -41,7 +65,14 @@ def compute_mse(mse_res: int, mse_spp: int, ref_spp: int):
 
     api = make_killeroo_like(res=mse_res, spp=mse_spp)
     scene, integ = compile_api(api)
-    img = np.asarray(integ.render(scene).image, np.float32)
+    result = integ.render(scene, max_seconds=max(remaining() - 10.0, 5.0))
+    if result.completed_fraction < 1.0:
+        print(
+            f"mse render incomplete ({result.completed_fraction:.0%}) — skipping",
+            file=sys.stderr,
+        )
+        return None
+    img = np.asarray(result.image, np.float32)
     return float(np.mean((img - ref) ** 2))
 
 
@@ -54,33 +85,80 @@ def main():
     api = make_killeroo_like(res=res, spp=spp)
     scene, integ = compile_api(api)
 
-    # warmup run with identical shapes so the timed run hits the jit cache
-    integ.render(scene)
-    result = integ.render(scene)
+    # Warmup: a tightly budgeted pass populates the jit cache (identical
+    # shapes). Its result doubles as the fallback measurement if compile
+    # ate the budget — a compile-tainted number still beats no number.
+    result = integ.render(scene, max_seconds=5)
+    if remaining() > 60:
+        result = integ.render(
+            scene, max_seconds=min(remaining() - 30.0, remaining() * 0.55)
+        )
 
-    mse = None
-    if not os.environ.get("BENCH_SKIP_MSE"):
-        try:
-            mse = compute_mse(
-                int(os.environ.get("MSE_RES", "128")),
-                int(os.environ.get("MSE_SPP", "256")),
-                int(os.environ.get("REF_SPP", "256")),
-            )
-        except Exception as e:  # noqa: BLE001 — MSE failure must not eat the perf number
-            print(f"mse computation failed: {e}", file=sys.stderr)
+    # measured rays per camera ray from the run just completed (the class
+    # default attribute is a lower bound; the real factor includes bounces
+    # and shadow segments)
+    cam_rays = res * res * spp * max(result.completed_fraction, 1e-6)
+    rays_ratio = max(result.rays_traced / max(cam_rays, 1.0), 1.0)
 
     north_star = 100.0  # Mray/s on v5e-8 (BASELINE.json north_star)
-    line = {
+    global _last_line
+    _last_line = {
         "metric": "killeroo_like_path_mray_per_sec",
         "value": round(result.mray_per_sec, 3),
         "unit": "Mray/s",
         "vs_baseline": round(result.mray_per_sec / north_star, 4),
+        "completed_fraction": round(result.completed_fraction, 4),
+        "rays_traced": result.rays_traced,
+        "seconds": round(result.seconds, 2),
     }
+
+    mse = None
+    if not os.environ.get("BENCH_SKIP_MSE"):
+        try:
+            mse_res = int(os.environ.get("MSE_RES", "128"))
+            mse_spp = int(os.environ.get("MSE_SPP", "256"))
+            # predicted cost of the MSE render from measured throughput
+            est_rays = mse_res * mse_res * mse_spp * rays_ratio
+            est_s = est_rays / max(result.mray_per_sec, 1e-6) / 1e6 + 30.0
+            budget = remaining() - 20.0
+            if est_s < budget:
+                mse = compute_mse(
+                    mse_res, mse_spp, int(os.environ.get("REF_SPP", "256"))
+                )
+            else:
+                print(
+                    f"skipping MSE: est {est_s:.0f}s > budget {budget:.0f}s",
+                    file=sys.stderr,
+                )
+        except Exception as e:  # noqa: BLE001 — MSE failure must not eat the perf number
+            print(f"mse computation failed: {e}", file=sys.stderr)
+
+    line = dict(_last_line)
     if mse is not None:
         line["mse_vs_cpu_ref"] = mse
         line["mse_target"] = 1e-4
     print(json.dumps(line))
 
 
+def _on_term(signum, frame):
+    raise RuntimeError(f"signal {signum}")
+
+
 if __name__ == "__main__":
-    main()
+    import signal
+
+    # `timeout` sends SIGTERM before SIGKILL: convert it into an exception
+    # so the fallback line below still prints under a driver timeout
+    signal.signal(signal.SIGTERM, _on_term)
+    try:
+        main()
+    except BaseException as e:  # noqa: BLE001 — ALWAYS print a parseable line
+        line = dict(_last_line) if _last_line else {
+            "metric": "killeroo_like_path_mray_per_sec",
+            "value": 0.0,
+            "unit": "Mray/s",
+            "vs_baseline": 0.0,
+        }
+        line["error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(line))
+        sys.exit(0)

@@ -176,9 +176,13 @@ class PathIntegrator(WavefrontIntegrator):
                 # not a scattering event; MIS still references the last real
                 # vertex
 
-            # ---- Russian roulette (after 3 REAL bounces: the per-lane
-            # depth counter, not the loop iteration — null crossings must
-            # not advance RR, matching pbrt's bounces-- semantics) --------
+            # ---- Russian roulette. pbrt path.cpp tests `bounces > 3` at
+            # the END of iteration `bounces`; our per-lane `depth` counter
+            # is post-increment here (depth == bounces + 1 for a lane that
+            # continued every iteration), so `depth > 4` is the SAME
+            # schedule — first possible kill after the 5th real bounce is
+            # sampled. depth counts REAL bounces only: null crossings must
+            # not advance RR (pbrt's bounces-- semantics). ----------------
             rr_on = depth > 4
             rr_beta = jnp.max(beta, axis=-1) * eta_scale
             q = jnp.maximum(0.05, 1.0 - rr_beta)
