@@ -210,3 +210,86 @@ def test_slab_nan_edge_on_ray_not_rejected():
     h = bvh_intersect(dev, tri, jnp.asarray([[0, 0, 0.0]], jnp.float32), jnp.asarray([[1, 0, 0]], jnp.float32), 1e30)
     assert int(h.prim[0]) == 0
     np.testing.assert_allclose(float(h.t[0]), 2.0, rtol=1e-5)
+
+
+# -------------------------------------------------------------------------
+# MXU feature-matmul leaf tests + packet/treelet traversal (accel/mxu.py,
+# accel/treelet.py, accel/packet.py)
+# -------------------------------------------------------------------------
+
+def _oracle_compare(hit, hit_bf, min_hits=20):
+    m = np.asarray(hit.prim >= 0)
+    mb = np.asarray(hit_bf.prim >= 0)
+    np.testing.assert_array_equal(m, mb)
+    assert mb.sum() > min_hits
+    np.testing.assert_allclose(
+        np.asarray(hit.t)[m], np.asarray(hit_bf.t)[m], rtol=1e-4, atol=1e-4
+    )
+    same = np.asarray(hit.prim) == np.asarray(hit_bf.prim)
+    assert same[m].mean() > 0.99
+
+
+def test_brute_feature_matches_oracle():
+    from tpu_pbrt.accel.mxu import brute_feature_intersect, tri_feature_weights
+    from tpu_pbrt.accel.traverse import brute_force_intersect
+
+    rng = np.random.default_rng(21)
+    tris = random_tris(200, rng)
+    ctr = tris.mean(axis=(0, 1))
+    feat = jnp.asarray(tri_feature_weights(tris, ctr))
+    o, d = random_rays(600, rng)
+    o, d = jnp.asarray(o), jnp.asarray(d)
+    hf = brute_feature_intersect(feat, jnp.asarray(ctr), 200, o, d, 1e30)
+    hb = brute_force_intersect(jnp.asarray(tris), o, d, 1e30, chunk=256)
+    _oracle_compare(hf, hb)
+
+
+def test_packet_matches_oracle():
+    from tpu_pbrt.accel.packet import packet_intersect, packet_intersect_p
+    from tpu_pbrt.accel.traverse import brute_force_intersect
+    from tpu_pbrt.accel.treelet import build_treelet_pack
+
+    rng = np.random.default_rng(23)
+    tris = random_tris(3000, rng)
+    bvh = bvh_build.build_bvh(*bvh_build.triangle_bounds(tris), method="sah")
+    tris_perm = tris[bvh.prim_order]
+    tp = build_treelet_pack(tris_perm, bvh)
+    assert tp.n_treelets > 8  # actually exercises the two-level walk
+    o, d = random_rays(700, rng)
+    o, d = jnp.asarray(o), jnp.asarray(d)
+    hp = packet_intersect(tp, o, d, 1e30)
+    hb = brute_force_intersect(jnp.asarray(tris_perm), o, d, 1e30, chunk=256)
+    _oracle_compare(hp, hb)
+    # any-hit predicate consistent with closest hit
+    np.testing.assert_array_equal(
+        np.asarray(packet_intersect_p(tp, o, d, 1e30)), np.asarray(hp.prim >= 0)
+    )
+
+
+def test_packet_t_max_respected():
+    from tpu_pbrt.accel.packet import packet_intersect
+    from tpu_pbrt.accel.treelet import build_treelet_pack
+
+    tris = np.asarray([[[0.0, -1, -1], [0, 1, -1], [0, 0, 1]]], np.float32)
+    bvh = bvh_build.build_bvh(*bvh_build.triangle_bounds(tris))
+    tp = build_treelet_pack(tris[bvh.prim_order], bvh)
+    o = jnp.asarray([[-5.0, 0, 0]])
+    d = jnp.asarray([[1.0, 0, 0]])
+    assert int(packet_intersect(tp, o, d, 10.0).prim[0]) == 0
+    assert int(packet_intersect(tp, o, d, 4.0).prim[0]) == -1
+
+
+def test_treelet_cut_covers_all_prims():
+    from tpu_pbrt.accel.treelet import cut_treelets
+
+    rng = np.random.default_rng(29)
+    tris = random_tris(2500, rng)
+    bvh = bvh_build.build_bvh(*bvh_build.triangle_bounds(tris), method="sah")
+    off, cnt, bmin, bmax = cut_treelets(bvh)
+    # treelet ranges tile [0, n) without gaps or overlap
+    spans = sorted(zip(off.tolist(), cnt.tolist()))
+    cursor = 0
+    for o_, c_ in spans:
+        assert o_ == cursor
+        cursor += c_
+    assert cursor == 2500

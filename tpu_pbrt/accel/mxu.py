@@ -1,0 +1,168 @@
+"""Triangle intersection as matrix multiply — the MXU leaf test.
+
+Capability match for pbrt-v3 src/shapes/triangle.cpp Triangle::Intersect
+(same hit set and barycentrics up to f32 rounding), re-derived for the
+TPU's systolic array. The key observation: every quantity the
+Möller–Trumbore test needs is a BILINEAR form in (ray, triangle). With
+e1 = v1-v0, e2 = v2-v0, s = o-v0, p = d x e2, q = s x e1:
+
+    det   = p . e1 = d . (e2 x e1)                    (linear in d)
+    u*det = p . s  = sum_ij o_i d_j [eps_ijk e2_k] - d . (e2 x v0)
+    v*det = q . d  = sum_ij o_i d_j [-eps_ijk e1_k] - d . (v0 x e1)
+    t*det = q . e2 = o . n - v0 . n,   n = e1 x e2    (linear in o)
+
+so with the 16-dim ray feature vector
+
+    phi(o, d) = [o_i d_j (9, i-major), d (3), o (3), 1]
+
+all four outputs for T triangles are one matmul phi @ W with per-triangle
+weights W in R^{16 x 4T} — exactly the (rays, 16) @ (16, 4T) shape the MXU
+wants. Intersecting a 64-triangle treelet against a 128-ray packet costs
+one small matmul instead of 64 gathered scalar tests.
+
+f32 precision: the o_i d_j features lose ~eps*|o||d| per term, so rays and
+vertices are RE-CENTERED per treelet (o' = o - c, v0' = v0 - c), bounding
+the cancellation by the treelet diameter instead of the scene diameter.
+The matmul runs at Precision.HIGHEST (3-pass f32 on TPU) — bf16 features
+would visibly crack edges. Edge behavior: unlike the shear-based
+watertight test (accel/traverse.py intersect_triangle, which this module
+does NOT replace for oracle/unit-test use), the barycentric comparisons
+here use a small epsilon band, so shared-edge rays may hit BOTH adjacent
+triangles (closest-t wins — harmless) but never leak through.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_pbrt.accel.traverse import Hit
+
+#: relative barycentric tolerance: widens each triangle by ~1e-6 so shared
+#: edges cannot crack open under f32 rounding (double hits resolve by t)
+EDGE_EPS = 1e-6
+
+#: scenes at or below this triangle count skip the treelet hierarchy and
+#: brute-force every triangle in one feature matmul (Cornell-class scenes)
+BRUTE_MAX_TRIS = 256
+
+
+def tri_feature_weights_raw(verts: np.ndarray, center) -> np.ndarray:
+    """(T,3,3) triangle vertices + re-centering point(s) -> (T, 16, 4)
+    per-triangle weights (outputs: det, u*det, v*det, t*det).
+
+    `center` broadcasts against (T,3,3) — pass (3,) for a shared center or
+    (T,1,3) for per-triangle centers. Degenerate (zero-area) triangles —
+    including padding rows — produce all-zero weights, so det == 0 and
+    they can never hit.
+    """
+    v = np.asarray(verts, np.float64) - np.asarray(center, np.float64)
+    v0, v1, v2 = v[:, 0], v[:, 1], v[:, 2]
+    e1 = v1 - v0
+    e2 = v2 - v0
+    n = np.cross(e1, e2)  # (T,3)
+    T = len(v)
+
+    eps = np.zeros((3, 3, 3))
+    eps[0, 1, 2] = eps[1, 2, 0] = eps[2, 0, 1] = 1.0
+    eps[0, 2, 1] = eps[2, 1, 0] = eps[1, 0, 2] = -1.0
+
+    W = np.zeros((T, 16, 4), np.float64)
+    # det = d . (e2 x e1) = -d . n
+    W[:, 9:12, 0] = -n
+    # u*det = sum o'_i d_j eps_ijk e2_k  -  d . (e2 x v0')
+    W[:, :9, 1] = np.einsum("ijk,tk->tij", eps, e2).reshape(T, 9)
+    W[:, 9:12, 1] = -np.cross(e2, v0)
+    # v*det = sum o'_i d_j (-eps_ijk e1_k)  -  d . (v0' x e1)
+    W[:, :9, 2] = -np.einsum("ijk,tk->tij", eps, e1).reshape(T, 9)
+    W[:, 9:12, 2] = -np.cross(v0, e1)
+    # t*det = o' . n - v0' . n
+    W[:, 12:15, 3] = n
+    W[:, 15, 3] = -np.sum(v0 * n, axis=-1)
+    return W.astype(np.float32)
+
+
+def tri_feature_weights(verts: np.ndarray, center) -> np.ndarray:
+    """(T,3,3) + shared center -> (16, 4T) matmul weights with column
+    layout [det (T) | u*det (T) | v*det (T) | t*det (T)]."""
+    W = tri_feature_weights_raw(verts, center)
+    T = len(W)
+    return np.ascontiguousarray(W.transpose(1, 2, 0).reshape(16, 4 * T))
+
+
+def ray_features(o_c, d):
+    """Re-centered origins (...,3) + directions (...,3) -> phi (...,16)."""
+    od = o_c[..., :, None] * d[..., None, :]  # (...,3,3) i-major
+    one = jnp.ones(o_c.shape[:-1] + (1,), o_c.dtype)
+    return jnp.concatenate(
+        [od.reshape(od.shape[:-2] + (9,)), d, o_c, one], axis=-1
+    )
+
+
+def decode_outputs(out, n_tris: int, t_max):
+    """Matmul output (..., 4T) -> per-ray closest hit over the T columns.
+
+    Returns (t, k, b0, b1) where k is the LOCAL triangle index in [0, T)
+    (or arbitrary when t == +inf => miss) and b0/b1 follow the Hit
+    convention (b0 = 1-u-v weight of v0, b1 = u weight of v1).
+    """
+    T = n_tris
+    det = out[..., 0 * T : 1 * T]
+    udet = out[..., 1 * T : 2 * T]
+    vdet = out[..., 2 * T : 3 * T]
+    tdet = out[..., 3 * T : 4 * T]
+    inv = 1.0 / jnp.where(det == 0.0, 1.0, det)
+    u = udet * inv
+    v = vdet * inv
+    t = tdet * inv
+    tm = t_max[..., None] if jnp.ndim(t_max) else t_max
+    hit = (
+        (det != 0.0)
+        & (u >= -EDGE_EPS)
+        & (v >= -EDGE_EPS)
+        & (u + v <= 1.0 + EDGE_EPS)
+        & (t > 0.0)
+        & (t < tm)
+    )
+    t = jnp.where(hit, t, jnp.inf)
+    k = jnp.argmin(t, axis=-1)
+    t_best = jnp.take_along_axis(t, k[..., None], axis=-1)[..., 0]
+    u_best = jnp.take_along_axis(u, k[..., None], axis=-1)[..., 0]
+    v_best = jnp.take_along_axis(v, k[..., None], axis=-1)[..., 0]
+    b0 = 1.0 - u_best - v_best
+    b1 = u_best
+    return t_best, k, b0, b1
+
+
+def brute_feature_intersect(feat, center, n_tris: int, o, d, t_max, chunk=32768):
+    """Closest hit of rays (R,3) against ALL n_tris triangles via one
+    feature matmul per ray slab (the small-scene acceleration path:
+    Cornell-class scenes need no hierarchy at all on the MXU)."""
+    t_max = jnp.broadcast_to(jnp.asarray(t_max, jnp.float32), o.shape[:-1])
+    R = o.shape[0]
+    n_slabs = max(1, (R + chunk - 1) // chunk)
+    pad = n_slabs * chunk - R
+    if pad:
+        o = jnp.concatenate([o, jnp.zeros((pad, 3), o.dtype)])
+        d = jnp.concatenate([d, jnp.ones((pad, 3), d.dtype)])
+        t_max = jnp.concatenate([t_max, jnp.full((pad,), -1.0, t_max.dtype)])
+
+    def slab(args):
+        oo, dd, tt = args
+        phi = ray_features(oo - center, dd)
+        out = jnp.matmul(phi, feat, precision=jax.lax.Precision.HIGHEST)
+        t, k, b0, b1 = decode_outputs(out, n_tris, tt)
+        prim = jnp.where(jnp.isfinite(t), k.astype(jnp.int32), -1)
+        return t, prim, b0, b1
+
+    t, prim, b0, b1 = jax.lax.map(
+        slab,
+        (
+            o.reshape(n_slabs, chunk, 3),
+            d.reshape(n_slabs, chunk, 3),
+            t_max.reshape(n_slabs, chunk),
+        ),
+    )
+    flat = lambda a: a.reshape(-1)[:R]  # noqa: E731
+    return Hit(flat(t), flat(prim), flat(b0), flat(b1))

@@ -59,8 +59,21 @@ from tpu_pbrt.core.vecmath import (
 )
 
 def scene_intersect(dev, o, d, t_max) -> Hit:
-    """Scene::Intersect — dispatches to the wide-BVH kernel when the scene
-    compiler provides one (the TPU-shaped default), else the binary walk."""
+    """Scene::Intersect — dispatches to the acceleration structure the
+    scene compiler chose: the packet/MXU two-level treelet BVH (TPU-shaped
+    default), the all-triangles feature matmul for tiny scenes, or the
+    legacy per-ray wide/binary walks (TPU_PBRT_BVH=wide|binary)."""
+    if "tpack" in dev:
+        from tpu_pbrt.accel.packet import packet_intersect
+
+        return packet_intersect(dev["tpack"], o, d, t_max)
+    if "bfeat" in dev:
+        from tpu_pbrt.accel.mxu import brute_feature_intersect
+
+        bf = dev["bfeat"]
+        return brute_feature_intersect(
+            bf["feat"], bf["center"], bf["feat"].shape[1] // 4, o, d, t_max
+        )
     if "wbvh" in dev:
         return wide_intersect(dev["wbvh"], dev["tri_verts"], o, d, t_max)
     return bvh_intersect(dev["bvh"], dev["tri_verts"], o, d, t_max)
@@ -68,6 +81,12 @@ def scene_intersect(dev, o, d, t_max) -> Hit:
 
 def scene_intersect_p(dev, o, d, t_max):
     """Scene::IntersectP — shadow-ray predicate."""
+    if "tpack" in dev:
+        from tpu_pbrt.accel.packet import packet_intersect_p
+
+        return packet_intersect_p(dev["tpack"], o, d, t_max)
+    if "bfeat" in dev:
+        return scene_intersect(dev, o, d, t_max).prim >= 0
     if "wbvh" in dev:
         return wide_intersect_p(dev["wbvh"], dev["tri_verts"], o, d, t_max)
     return bvh_intersect_p(dev["bvh"], dev["tri_verts"], o, d, t_max)

@@ -895,11 +895,14 @@ def compile_scene(api) -> CompiledScene:
     mtab = lower_materials(mat_records, tex_registry)
 
     # -- device upload ---------------------------------------------------
-    # One BVH only (VERDICT r1 weak #4: no duplicate geometry in HBM).
-    # The wide (8-ary) BVH is the TPU-shaped default; TPU_PBRT_BVH=binary
-    # selects the LinearBVHNode walk for A/B comparison. tri_verts is
-    # padded (degenerate rows) so the wide leaf dynamic_slice stays in
-    # bounds; interaction gathers never index the padding (prim < n_tris).
+    # One acceleration structure only (VERDICT r1 weak #4: no duplicate
+    # geometry in HBM). The packet/MXU two-level treelet BVH is the
+    # TPU-shaped default (accel/packet.py); scenes at or below
+    # BRUTE_MAX_TRIS skip the hierarchy and brute-force all triangles in
+    # one feature matmul. TPU_PBRT_BVH=wide|binary selects the legacy
+    # per-ray walks for A/B comparison. tri_verts is padded (degenerate
+    # rows) so fixed-size leaf slices stay in bounds; interaction gathers
+    # never index the padding (prim < n_tris).
     import os as _os
 
     from tpu_pbrt.accel.wide import build_wide, pad_tri_verts
@@ -919,10 +922,22 @@ def compile_scene(api) -> CompiledScene:
         "world_radius": jnp.float32(wradius),
         "n_lights": jnp.int32(n_lights if light_rows else 0),
     }
-    if _os.environ.get("TPU_PBRT_BVH", "wide") == "binary":
+    accel_kind = _os.environ.get("TPU_PBRT_BVH", "packet")
+    if accel_kind == "binary":
         dev["bvh"] = bvh_as_device_dict(bvh)
-    else:
+    elif accel_kind == "wide":
         dev["wbvh"] = build_wide(bvh)
+    else:
+        from tpu_pbrt.accel.mxu import BRUTE_MAX_TRIS, tri_feature_weights
+        from tpu_pbrt.accel.treelet import build_treelet_pack
+
+        if len(verts) <= BRUTE_MAX_TRIS:
+            dev["bfeat"] = {
+                "feat": jnp.asarray(tri_feature_weights(verts, wcenter)),
+                "center": jnp.asarray(wcenter, jnp.float32),
+            }
+        else:
+            dev["tpack"] = build_treelet_pack(verts, bvh)
     if has_envmap:
         dev["envmap"] = jnp.asarray(envmap, jnp.float32)
         dev["env_distr"] = env_distr
