@@ -293,3 +293,51 @@ def test_treelet_cut_covers_all_prims():
         assert o_ == cursor
         cursor += c_
     assert cursor == 2500
+
+
+# -------------------------------------------------------------------------
+# Stream (sort/compaction wavefront) traversal — accel/stream.py
+# -------------------------------------------------------------------------
+
+def test_stream_matches_oracle():
+    from tpu_pbrt.accel.stream import (
+        STREAM_LEAF_TRIS,
+        stream_intersect,
+        stream_intersect_p,
+        stream_traverse_stats,
+    )
+    from tpu_pbrt.accel.traverse import brute_force_intersect
+    from tpu_pbrt.accel.treelet import build_treelet_pack
+
+    rng = np.random.default_rng(31)
+    tris = random_tris(3000, rng)
+    bvh = bvh_build.build_bvh(*bvh_build.triangle_bounds(tris), method="sah")
+    tris_perm = tris[bvh.prim_order]
+    tp = build_treelet_pack(tris_perm, bvh, leaf_tris=STREAM_LEAF_TRIS)
+    assert tp.n_treelets > 8
+    o, d = random_rays(700, rng)
+    o, d = jnp.asarray(o), jnp.asarray(d)
+    hs = stream_intersect(tp, o, d, 1e30)
+    hb = brute_force_intersect(jnp.asarray(tris_perm), o, d, 1e30, chunk=256)
+    _oracle_compare(hs, hb)
+    np.testing.assert_array_equal(
+        np.asarray(stream_intersect_p(tp, o, d, 1e30)), np.asarray(hs.prim >= 0)
+    )
+    # worklist capacity must never overflow (overflow = silent false misses)
+    *_, n_drop, _ = stream_traverse_stats(tp, o, d, 1e30)
+    assert int(n_drop) == 0
+
+
+def test_stream_t_max_and_degenerate():
+    from tpu_pbrt.accel.stream import STREAM_LEAF_TRIS, stream_intersect
+    from tpu_pbrt.accel.treelet import build_treelet_pack
+
+    tris = np.asarray([[[0.0, -1, -1], [0, 1, -1], [0, 0, 1]]], np.float32)
+    bvh = bvh_build.build_bvh(*bvh_build.triangle_bounds(tris))
+    tp = build_treelet_pack(tris[bvh.prim_order], bvh, leaf_tris=STREAM_LEAF_TRIS)
+    o = jnp.asarray([[-5.0, 0, 0]])
+    d = jnp.asarray([[1.0, 0, 0]])
+    assert int(stream_intersect(tp, o, d, 10.0).prim[0]) == 0
+    assert int(stream_intersect(tp, o, d, 4.0).prim[0]) == -1
+    # dead rays (t_max <= 0) must report misses
+    assert int(stream_intersect(tp, o, d, -1.0).prim[0]) == -1

@@ -42,7 +42,7 @@ import jax.numpy as jnp
 from tpu_pbrt.accel.mxu import decode_outputs, ray_features
 from tpu_pbrt.accel.traverse import Hit
 from tpu_pbrt.accel.treelet import TreeletPack
-from tpu_pbrt.accel.wide import _BOX_EPS, _EMPTY, MAX_STACK
+from tpu_pbrt.accel.wide import _EMPTY, MAX_STACK
 
 LANE = 128
 LEAF_QUEUE = 64
@@ -97,15 +97,12 @@ def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool):
 
         # slab test: every lane vs all 8 children, far plane clamped by the
         # lane's current t (adaptive front-to-back culling)
-        lo = jnp.where(inv_d[:, :, None, :] < 0, nmax[:, None], nmin[:, None])
-        hi = jnp.where(inv_d[:, :, None, :] < 0, nmin[:, None], nmax[:, None])
-        t0 = (lo - o[:, :, None, :]) * inv_d[:, :, None, :]
-        t1 = (hi - o[:, :, None, :]) * inv_d[:, :, None, :] * _BOX_EPS
-        t0 = jnp.where(jnp.isnan(t0), -jnp.inf, t0)
-        t1 = jnp.where(jnp.isnan(t1), jnp.inf, t1)
-        tn = jnp.maximum(jnp.max(t0, axis=-1), 0.0)  # (P,LANE,8)
-        tf = jnp.minimum(jnp.min(t1, axis=-1), s.t[:, :, None])
-        lane_hit = tn <= tf  # (P,LANE,8)
+        from tpu_pbrt.accel.wide import slab_test
+
+        tn, _, lane_hit = slab_test(
+            nmin[:, None], nmax[:, None], o[:, :, None, :],
+            inv_d[:, :, None, :], s.t[:, :, None],
+        )  # (P,LANE,8)
         hit8 = jnp.any(lane_hit, axis=1) & (cids != _EMPTY) & expand[:, None]
         tn_pkt = jnp.min(jnp.where(lane_hit, tn, jnp.inf), axis=1)  # (P,8)
 

@@ -1,0 +1,365 @@
+"""Stream (sort/compaction wavefront) BVH traversal — the fast trace path.
+
+Capability match for pbrt-v3 src/accelerators/bvh.cpp
+BVHAccel::Intersect/IntersectP (same closest-hit/any-hit semantics over the
+same SAH tree), re-architected a second time for TPU execution behavior.
+
+Why not the packet walk (accel/packet.py): packets amortize node fetches
+only while the 128 rays in a packet agree on a traversal path. Bounce rays
+(cosine-sampled hemispheres) disagree almost immediately, the packet's
+union frustum covers the whole scene, and every lane pays for every node
+any lane wants — measured 4 orders of magnitude slower than coherent
+camera rays on the same kernel.
+
+Why not a per-ray stack walk (accel/wide.py): a vmapped while_loop makes
+every ray pay the worst ray's iteration count, and each iteration moves a
+few hundred bytes per ray — far below the row sizes TPU memory wants.
+
+The stream design has NO per-ray control flow at all. Traversal state is
+one flat LIFO worklist of (ray, node, t_entry) pairs shared by the whole
+wave, processed in large dense slabs. The primitive costs measured on this
+v5e (in-jit repetition, amortizing the ~100 ms tunnel round-trip) dictate
+the shape of every step: scatters ~10-35 ms per 512k elements, sorts ~2 ms
+per 512k keys, row gathers ~8 ns/row, contiguous dynamic slices and dense
+vector/MXU math effectively free. So the design is SORT-BASED and
+scatter-free everywhere a sort can stand in for a scatter:
+
+- EXPAND pops a slab of SLAB pairs at once (one contiguous dynamic_slice),
+  culls pairs whose recorded entry distance already exceeds their ray's
+  current hit, slab-tests each pair's ray against its node's 8 child boxes
+  in one dense (SLAB, 8) test — one packed (8,6)-float box row and one
+  packed (6,)-float ray row per pair — then compacts the 8*SLAB child
+  candidates with ONE sort on a single f32 key: hit leaves sort to the
+  front (key -inf), hit interior children next ordered far-to-near (key
+  -t_entry), everything else to the back (key +inf). The sorted prefix is
+  appended to the leaf buffer and the interior span is pushed onto the
+  stack with two contiguous dynamic_update_slices — no scatter, and the
+  global far-to-near order means the next pop takes the wave's nearest
+  subtrees first (stronger front-to-back culling than per-node child
+  ordering).
+- FLUSH runs when the leaf buffer is nearly full (or the stack empties):
+  it sorts the buffered (ray, treelet) pairs by treelet id, so each
+  treelet's rays form a contiguous run; block starts come from a
+  searchsorted over the run ids (binary search, not scatter), and each
+  128-ray block is intersected against its treelet's triangles in one MXU
+  feature matmul (accel/mxu.py): (128, 16) ray features x (16, 4L)
+  per-treelet Moller-Trumbore weights. Closest hits merge into per-ray
+  state by scatter-min (+ an equality-select scatter for the payload, the
+  standard two-pass argmin trick) — the one place a scatter is
+  unavoidable, paid per tested block slot.
+
+Sequential depth per wave is therefore ~(total pairs / SLAB) big dense
+steps instead of per-ray tree depth times worst-lane divergence, and leaf
+work lands on the MXU in (128, 16) @ (16, 4L) tiles regardless of ray
+order. Ray coherence changes only the pair COUNT (coherent rays produce
+fewer pairs), never the execution shape — the design goal for a wavefront
+path tracer whose bounce waves are inherently incoherent.
+
+The acceleration structure is the same two-level TreeletPack as the packet
+walk (accel/treelet.py) with fatter leaves (STREAM_LEAF_TRIS = 128): the
+MXU makes triangle tests nearly free, so trading deeper trees for fatter
+matmuls moves work from the latency-bound worklist to the compute units.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_pbrt.accel.mxu import decode_outputs, ray_features
+from tpu_pbrt.accel.traverse import Hit
+from tpu_pbrt.accel.treelet import TreeletPack, decode_top_leaf
+from tpu_pbrt.accel.wide import _EMPTY, slab_test
+
+#: triangles per treelet for the stream path (feature row = 4*this columns)
+STREAM_LEAF_TRIS = 128
+#: rays per leaf block — the MXU matmul's row dimension
+BLOCK = 128
+#: leaf blocks processed per flush chunk (bounds transient memory: the
+#: chunk's matmul output is CHUNK*BLOCK*4L floats)
+CHUNK = 512
+#: safety bound on while_loop iterations (real waves take tens to hundreds)
+_MAX_ITERS = 1 << 16
+
+
+class _SState(NamedTuple):
+    t: jnp.ndarray  # (R,) current closest hit (or t_max)
+    prim: jnp.ndarray  # (R,) i32 global leaf-order triangle id, -1 miss
+    b0: jnp.ndarray  # (R,)
+    b1: jnp.ndarray  # (R,)
+    stk_node: jnp.ndarray  # (W + headroom,) i32 top-tree node / treelet code
+    stk_ray: jnp.ndarray  # (W + headroom,) i32 ray ids
+    stk_tn: jnp.ndarray  # (W + headroom,) i32 bitcast f32 entry distance
+    n_stk: jnp.ndarray  # i32
+    lf_tid: jnp.ndarray  # (LB + headroom,) i32 treelet ids
+    lf_ray: jnp.ndarray  # (LB + headroom,) i32
+    lf_tn: jnp.ndarray  # (LB + headroom,) i32 bitcast f32
+    n_lf: jnp.ndarray  # i32
+    n_drop: jnp.ndarray  # i32 pairs lost to capacity (tests assert 0)
+    n_exp: jnp.ndarray  # i32 stat: pairs expanded
+    n_tl: jnp.ndarray  # i32 stat: (ray, treelet) block-slot tests
+    iters: jnp.ndarray  # i32
+
+
+def _sizes(R: int):
+    """Static worklist sizes for a wave of R rays."""
+    slab = int(min(max(R // 4, 4096), 1 << 17))
+    w = R + 24 * slab
+    lb = 12 * slab
+    return slab, w, lb
+
+
+def _bits(x):
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
+
+
+def _unbits(x):
+    return jax.lax.bitcast_convert_type(x, jnp.float32)
+
+
+def _expand(tp: TreeletPack, boxes, o_inv, s: _SState, slab: int, w: int,
+            lb: int, any_hit: bool):
+    top = tp.top
+    start = jnp.maximum(s.n_stk - slab, 0)
+    k = jnp.arange(slab, dtype=jnp.int32)
+    valid = k < (s.n_stk - start)
+    node = jnp.where(valid, jax.lax.dynamic_slice(s.stk_node, (start,), (slab,)), 0)
+    rid = jnp.where(valid, jax.lax.dynamic_slice(s.stk_ray, (start,), (slab,)), 0)
+    tn_in = jnp.where(
+        valid, _unbits(jax.lax.dynamic_slice(s.stk_tn, (start,), (slab,))), jnp.inf
+    )
+    t_r = s.t[rid]
+    live = valid & (tn_in <= t_r)
+    if any_hit:
+        live = live & (s.prim[rid] < 0)
+
+    nbox = boxes[node]  # (S, 8, 6): one packed row per pair
+    nmin = nbox[..., :3]
+    nmax = nbox[..., 3:]
+    cids = top.child_idx[node]  # (S, 8)
+    ray6 = o_inv[rid]  # (S, 6): origin | 1/d
+    o_r = ray6[:, None, :3]
+    inv_r = ray6[:, None, 3:]
+    tn8, _, in_slab = slab_test(nmin, nmax, o_r, inv_r, t_r[:, None])  # (S,8)
+    hit8 = live[:, None] & in_slab & (cids != _EMPTY)
+    is_int = hit8 & (cids >= 0)
+    is_leaf = hit8 & (cids < 0)
+
+    # ---- sort-based compaction of the 8S child candidates ---------------
+    # key: leaves first (-inf), interiors far-to-near (-t_entry: the wave's
+    # NEAREST subtrees end up on top of the LIFO stack), dead last (+inf)
+    key = jnp.where(
+        is_leaf, -jnp.inf, jnp.where(is_int, -tn8, jnp.inf)
+    ).reshape(-1)
+    cand_code = jnp.where(is_leaf, decode_top_leaf(cids), cids).reshape(-1)
+    cand_ray = jnp.broadcast_to(rid[:, None], cids.shape).reshape(-1)
+    cand_tn = _bits(tn8).reshape(-1)
+    _, code_s, ray_s, tn_s = jax.lax.sort(
+        [key, cand_code, cand_ray, cand_tn], num_keys=1
+    )
+    n_leaf = jnp.sum(is_leaf, dtype=jnp.int32)
+    n_int = jnp.sum(is_int, dtype=jnp.int32)
+    s8 = 8 * slab
+
+    # append the leaf prefix to the leaf buffer (contiguous write; the up
+    # to 8S garbage entries past n_leaf land in headroom/garbage region and
+    # are overwritten by the next append or masked by n_lf)
+    lf_tid = jax.lax.dynamic_update_slice(s.lf_tid, code_s, (s.n_lf,))
+    lf_ray = jax.lax.dynamic_update_slice(s.lf_ray, ray_s, (s.n_lf,))
+    lf_tn = jax.lax.dynamic_update_slice(s.lf_tn, tn_s, (s.n_lf,))
+    n_lf_new = s.n_lf + n_leaf
+    dropped = jnp.maximum(n_lf_new - lb, 0)
+    n_lf_new = jnp.minimum(n_lf_new, lb)
+
+    # push the interior span [n_leaf, n_leaf + n_int) onto the stack: slice
+    # it out of the (padded to 16S) sorted arrays at the dynamic offset,
+    # then one contiguous write at the stack top
+    pad = jnp.full((s8,), _EMPTY, jnp.int32)
+    int_code = jax.lax.dynamic_slice(
+        jnp.concatenate([code_s, pad]), (n_leaf,), (s8,)
+    )
+    int_ray = jax.lax.dynamic_slice(
+        jnp.concatenate([ray_s, pad]), (n_leaf,), (s8,)
+    )
+    int_tn = jax.lax.dynamic_slice(
+        jnp.concatenate([tn_s, pad]), (n_leaf,), (s8,)
+    )
+    stk_node = jax.lax.dynamic_update_slice(s.stk_node, int_code, (start,))
+    stk_ray = jax.lax.dynamic_update_slice(s.stk_ray, int_ray, (start,))
+    stk_tn = jax.lax.dynamic_update_slice(s.stk_tn, int_tn, (start,))
+    n_stk_new = start + n_int
+    dropped = dropped + jnp.maximum(n_stk_new - w, 0)
+    n_stk_new = jnp.minimum(n_stk_new, w)
+
+    return s._replace(
+        stk_node=stk_node, stk_ray=stk_ray, stk_tn=stk_tn, n_stk=n_stk_new,
+        lf_tid=lf_tid, lf_ray=lf_ray, lf_tn=lf_tn, n_lf=n_lf_new,
+        n_drop=s.n_drop + dropped,
+        n_exp=s.n_exp + jnp.sum(live, dtype=jnp.int32),
+        iters=s.iters + 1,
+    )
+
+
+def _flush(tp: TreeletPack, o, d, s: _SState, lb: int, any_hit: bool):
+    R = s.t.shape[0]
+    C = tp.n_treelets
+    L = tp.leaf_tris
+    # n_lf <= lb always, so the sort/scan pipeline works on the (lb,)
+    # prefix — the append headroom past lb never holds countable pairs
+    lb_v = min(lb, s.lf_tid.shape[0])
+    b_cap = lb_v // BLOCK + C + 2
+    chunk = min(CHUNK, b_cap)
+
+    idx = jnp.arange(lb_v, dtype=jnp.int32)
+    tn0 = _unbits(s.lf_tn[:lb_v])
+    ray_c = jnp.clip(s.lf_ray[:lb_v], 0, R - 1)
+    live = (idx < s.n_lf) & (s.lf_tid[:lb_v] >= 0) & (tn0 <= s.t[ray_c])
+    if any_hit:
+        live = live & (s.prim[ray_c] < 0)
+    key = jnp.where(live, s.lf_tid[:lb_v], C)
+    key_s, rid_s = jax.lax.sort([key, ray_c], num_keys=1)
+    valid_s = key_s < C
+    prev = jnp.concatenate([jnp.full((1,), -1, key_s.dtype), key_s[:-1]])
+    newrun = valid_s & (key_s != prev)
+    # block breaks at run starts OR 128-aligned positions: every block
+    # stays within one treelet run and spans at most BLOCK pairs, without
+    # needing a rank-within-run scan — the in_blk mask in the chunk loop
+    # already handles blocks that end early
+    brk = newrun | (valid_s & (idx % BLOCK == 0))
+    blk_of = jnp.cumsum(brk.astype(jnp.int32)) - 1  # sorted ascending
+    n_blocks = jnp.max(jnp.where(valid_s, blk_of, -1)) + 1
+    # block b's pairs start at the first sorted position with blk_of == b:
+    # a binary search over the monotone blk_of (scatter-free)
+    block_start = jnp.searchsorted(
+        blk_of, jnp.arange(b_cap, dtype=jnp.int32), side="left"
+    ).astype(jnp.int32)
+
+    def chunk_cond(c):
+        return c[0] < n_blocks
+
+    def chunk_body(c):
+        cstart, t, prim, b0, b1, n_tl = c
+        bids = cstart + jnp.arange(chunk, dtype=jnp.int32)  # (CH,)
+        # gather (not dynamic_slice): a slice's clamped start would
+        # misalign starts against bids on the last chunk when n_blocks
+        # approaches b_cap, silently dropping or misbinding trailing blocks
+        starts = block_start[jnp.minimum(bids, b_cap - 1)]
+        pos = jnp.minimum(starts[:, None] + jnp.arange(BLOCK), lb_v - 1)
+        in_blk = blk_of[pos] == bids[:, None]  # masks run ends + overflow
+        rows = jnp.where(in_blk, rid_s[pos], -1)  # (CH, BLOCK) ray ids
+        tids = jnp.where(bids < n_blocks, key_s[jnp.minimum(starts, lb_v - 1)], 0)
+        tids = jnp.clip(tids, 0, C - 1)
+        has_ray = rows >= 0
+        rid = jnp.where(has_ray, rows, 0)
+        o_b = o[rid]  # (CH, BLOCK, 3)
+        d_b = d[rid]
+        t_b = jnp.where(has_ray, t[rid], -jnp.inf)  # dead slots: t<tm fails
+        ctr = tp.center[tids]  # (CH, 3)
+        off = tp.offset[tids]  # (CH,)
+        feat = tp.feat[tids]  # (CH, 16, 4L)
+        phi = ray_features(o_b - ctr[:, None, :], d_b)
+        out = jnp.einsum(
+            "cbf,cfk->cbk", phi, feat, precision=jax.lax.Precision.HIGHEST
+        )
+        t_loc, k_loc, b0_loc, b1_loc = decode_outputs(out, L, t_b)
+        won = has_ray & jnp.isfinite(t_loc)  # t_loc < t[ray] by decode
+        flat_rid = jnp.where(won, rid, R).reshape(-1)
+        t2 = t.at[flat_rid].min(t_loc.reshape(-1), mode="drop")
+        # equality-select second pass: pairs matching the post-min value
+        # write the payload (ties pick an arbitrary winner, as in any
+        # closest-hit tie)
+        win2 = won & (t_loc == t2[rid])
+        sel = jnp.where(win2, rid, R).reshape(-1)
+        prim2 = prim.at[sel].set(
+            (off[:, None] + k_loc.astype(jnp.int32)).reshape(-1), mode="drop"
+        )
+        b0_2 = b0.at[sel].set(b0_loc.reshape(-1), mode="drop")
+        b1_2 = b1.at[sel].set(b1_loc.reshape(-1), mode="drop")
+        return (
+            cstart + chunk, t2, prim2, b0_2, b1_2,
+            n_tl + jnp.sum(has_ray, dtype=jnp.int32),
+        )
+
+    init = (jnp.int32(0), s.t, s.prim, s.b0, s.b1, s.n_tl)
+    _, t, prim, b0, b1, n_tl = jax.lax.while_loop(chunk_cond, chunk_body, init)
+    return s._replace(
+        t=t, prim=prim, b0=b0, b1=b1,
+        n_lf=jnp.int32(0), n_tl=n_tl, iters=s.iters + 1,
+    )
+
+
+def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool) -> _SState:
+    R = o.shape[0]
+    slab, w, lb = _sizes(R)
+    s8 = 8 * slab
+    inv_d = 1.0 / d
+    o_inv = jnp.concatenate([o, inv_d], axis=-1)  # (R, 6): one gather row
+    boxes = jnp.concatenate(
+        [tp.top.child_bmin, tp.top.child_bmax], axis=-1
+    )  # (N, 8, 6): one gather row
+
+    rid0 = jnp.arange(R, dtype=jnp.int32)
+    tn0 = _bits(jnp.where(t_max > 0.0, 0.0, jnp.inf).astype(jnp.float32))
+    init = _SState(
+        t=jnp.asarray(t_max, jnp.float32),
+        prim=jnp.full((R,), -1, jnp.int32),
+        b0=jnp.zeros((R,), jnp.float32),
+        b1=jnp.zeros((R,), jnp.float32),
+        stk_node=jnp.zeros((w + s8,), jnp.int32),  # [0:R] = root
+        stk_ray=jnp.zeros((w + s8,), jnp.int32).at[:R].set(rid0),
+        stk_tn=jnp.full((w + s8,), _bits(jnp.float32(jnp.inf)), jnp.int32)
+        .at[:R]
+        .set(tn0),
+        n_stk=jnp.int32(R),
+        lf_tid=jnp.full((lb + s8,), -1, jnp.int32),
+        lf_ray=jnp.zeros((lb + s8,), jnp.int32),
+        lf_tn=jnp.zeros((lb + s8,), jnp.int32),
+        n_lf=jnp.int32(0),
+        n_drop=jnp.int32(0), n_exp=jnp.int32(0), n_tl=jnp.int32(0),
+        iters=jnp.int32(0),
+    )
+
+    def cond(s: _SState):
+        return ((s.n_stk > 0) | (s.n_lf > 0)) & (s.iters < _MAX_ITERS)
+
+    def body(s: _SState):
+        do_flush = (s.n_lf > lb - s8) | (s.n_stk == 0)
+        return jax.lax.cond(
+            do_flush,
+            lambda ss: _flush(tp, o, d, ss, lb, any_hit),
+            lambda ss: _expand(tp, boxes, o_inv, ss, slab, w, lb, any_hit),
+            s,
+        )
+
+    return jax.lax.while_loop(cond, body, init)
+
+
+@partial(jax.jit, static_argnames=("any_hit",))
+def stream_intersect(tp: TreeletPack, o, d, t_max, any_hit: bool = False) -> Hit:
+    """Closest hit (or first-hit source for the any-hit predicate) for a
+    flat ray batch. o, d: (R, 3); t_max scalar or (R,). Returns Hit with
+    global leaf-order triangle ids — API-compatible with bvh_intersect /
+    wide_intersect / packet_intersect."""
+    t_max = jnp.broadcast_to(jnp.asarray(t_max, jnp.float32), o.shape[:-1])
+    s = _traverse(tp, o, d, t_max, any_hit)
+    t = jnp.where(s.prim >= 0, s.t, jnp.inf)
+    return Hit(t, s.prim, s.b0, s.b1)
+
+
+def stream_intersect_p(tp: TreeletPack, o, d, t_max):
+    """Any-hit (shadow) predicate -> bool (R,)."""
+    return stream_intersect(tp, o, d, t_max, any_hit=True).prim >= 0
+
+
+@partial(jax.jit, static_argnames=("any_hit",))
+def stream_traverse_stats(tp: TreeletPack, o, d, t_max, any_hit: bool = False):
+    """(pairs expanded, leaf block-slot tests, pairs dropped, loop iters)
+    for the stats subsystem, perf analysis, and the capacity-overflow
+    regression test."""
+    t_max = jnp.broadcast_to(jnp.asarray(t_max, jnp.float32), o.shape[:-1])
+    s = _traverse(tp, o, d, t_max, any_hit)
+    return s.n_exp, s.n_tl, s.n_drop, s.iters

@@ -46,6 +46,25 @@ _LEAF_STRIDE = MAX_LEAF_PRIMS + 1
 _EMPTY = np.int32(2**30)  # empty slot: bounds are +inf/-inf, never hit
 
 
+def slab_test(nmin, nmax, o, inv_d, t_far):
+    """Conservative watertight ray/AABB slab test, shared by every walker
+    (wide/packet/stream) so the epsilon and NaN semantics cannot diverge.
+
+    nmin/nmax: (..., 3) child bounds; o/inv_d: (..., 3) broadcastable ray;
+    t_far: (...) far clip (current closest hit). Returns (t_near, t_far,
+    hit) with t_near >= 0 and the 0*inf NaN treated as inside-slab (pbrt's
+    conservative ordering: bvh.cpp IntersectP's gamma-widened slabs)."""
+    lo = jnp.where(inv_d < 0, nmax, nmin)
+    hi = jnp.where(inv_d < 0, nmin, nmax)
+    t0 = (lo - o) * inv_d
+    t1 = (hi - o) * inv_d * _BOX_EPS
+    t0 = jnp.where(jnp.isnan(t0), -jnp.inf, t0)
+    t1 = jnp.where(jnp.isnan(t1), jnp.inf, t1)
+    tn = jnp.maximum(jnp.max(t0, axis=-1), 0.0)
+    tf = jnp.minimum(jnp.min(t1, axis=-1), t_far)
+    return tn, tf, tn <= tf
+
+
 class WideBVH(NamedTuple):
     child_bmin: jnp.ndarray  # (N, 8, 3)
     child_bmax: jnp.ndarray  # (N, 8, 3)
@@ -223,15 +242,8 @@ def _ray_traverse_wide(w: WideBVH, tri_flat, o, d, t_max, any_hit: bool):
         nmin = w.child_bmin[node]  # (8,3) one contiguous row
         nmax = w.child_bmax[node]
         cids = w.child_idx[node]
-        lo = jnp.where(inv_d < 0, nmax, nmin)
-        hi = jnp.where(inv_d < 0, nmin, nmax)
-        t0 = (lo - o) * inv_d
-        t1 = (hi - o) * inv_d * _BOX_EPS
-        t0 = jnp.where(jnp.isnan(t0), -jnp.inf, t0)
-        t1 = jnp.where(jnp.isnan(t1), jnp.inf, t1)
-        tn = jnp.maximum(jnp.max(t0, axis=-1), 0.0)
-        tf = jnp.minimum(jnp.min(t1, axis=-1), t_new)
-        hit8 = (~is_leaf) & (tn <= tf) & (cids != _EMPTY)
+        tn, _, in_slab = slab_test(nmin, nmax, o, inv_d, t_new)
+        hit8 = (~is_leaf) & in_slab & (cids != _EMPTY)
 
         # push far-to-near so near children pop first
         key = jnp.where(hit8, tn, -jnp.inf)

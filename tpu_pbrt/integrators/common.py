@@ -60,9 +60,14 @@ from tpu_pbrt.core.vecmath import (
 
 def scene_intersect(dev, o, d, t_max) -> Hit:
     """Scene::Intersect — dispatches to the acceleration structure the
-    scene compiler chose: the packet/MXU two-level treelet BVH (TPU-shaped
-    default), the all-triangles feature matmul for tiny scenes, or the
-    legacy per-ray wide/binary walks (TPU_PBRT_BVH=wide|binary)."""
+    scene compiler chose: the stream (sort/compaction wavefront) tracer
+    (TPU-shaped default, coherence-independent), the all-triangles feature
+    matmul for tiny scenes, or the packet/wide/binary walkers
+    (TPU_PBRT_BVH=packet|wide|binary)."""
+    if "tstream" in dev:
+        from tpu_pbrt.accel.stream import stream_intersect
+
+        return stream_intersect(dev["tstream"], o, d, t_max)
     if "tpack" in dev:
         from tpu_pbrt.accel.packet import packet_intersect
 
@@ -81,6 +86,10 @@ def scene_intersect(dev, o, d, t_max) -> Hit:
 
 def scene_intersect_p(dev, o, d, t_max):
     """Scene::IntersectP — shadow-ray predicate."""
+    if "tstream" in dev:
+        from tpu_pbrt.accel.stream import stream_intersect_p
+
+        return stream_intersect_p(dev["tstream"], o, d, t_max)
     if "tpack" in dev:
         from tpu_pbrt.accel.packet import packet_intersect_p
 
@@ -397,13 +406,19 @@ class WavefrontIntegrator:
         n_dev = 1 if mesh is None else mesh.devices.size
         import os as _os
 
-        # Default chunk: on the axon-tunneled TPU a single dispatch must
-        # stay under the tunnel's wall-clock watchdog (~60-90 s kills the
-        # worker), which at current kernel throughput means <= 8k camera
-        # rays per dispatch; CPU (tests) has no such limit and prefers
-        # fewer, larger dispatches.
+        # Default chunk: the stream tracer's sort/compaction steps amortize
+        # over BIG waves, so TPU dispatches carry 256k camera rays (a path
+        # chunk = ~2·maxdepth traversal waves, comfortably under the
+        # tunnel's ~60-90 s dispatch watchdog). The legacy per-ray walkers
+        # (TPU_PBRT_BVH=packet|wide|binary) are orders of magnitude slower
+        # on divergent waves and keep the watchdog-safe 8k dispatches. CPU
+        # (tests) prefers smaller programs to bound compile time.
         is_tpu = jax.devices()[0].platform != "cpu"
-        default_chunk = (1 << 13) if is_tpu else min(MAX_RAYS_PER_DISPATCH >> 1, 1 << 17)
+        if is_tpu:
+            accel = _os.environ.get("TPU_PBRT_BVH", "stream")
+            default_chunk = (1 << 18) if accel == "stream" else (1 << 13)
+        else:
+            default_chunk = min(MAX_RAYS_PER_DISPATCH >> 1, 1 << 17)
         chunk = int(_os.environ.get("TPU_PBRT_CHUNK", default_chunk))
         chunk = min(chunk, max(1024 * n_dev, total))
         chunk = (chunk // n_dev) * n_dev
@@ -555,6 +570,33 @@ class WavefrontIntegrator:
             jax.block_until_ready(state)
         secs = time.time() - t0
         progress.done()
+        if _os.environ.get("TPU_PBRT_AUDIT_DROPS") and "tstream" in dev:
+            # Post-render capacity audit: the stream tracer's worklists are
+            # heuristically sized (accel/stream.py _sizes) and a capacity
+            # overflow would silently drop the NEAREST subtrees (false
+            # misses). Re-trace one camera-ray chunk through the stats
+            # variant and warn loudly if any pair was ever dropped. This
+            # audits the primary wave only — bounce waves produce FEWER
+            # simultaneous pairs (dead lanes cull at init), so the camera
+            # wave bounds the live worklist for a given chunk size.
+            from tpu_pbrt.accel.stream import stream_traverse_stats
+
+            k = jnp.arange(min(chunk, total), dtype=jnp.int32)
+            pix = k // spp
+            p_film0 = jnp.stack(
+                [(x0 + pix % w).astype(jnp.float32) + 0.5,
+                 (y0 + pix // w).astype(jnp.float32) + 0.5], axis=-1)
+            o0, d0, _ = generate_rays(cam, p_film0, jnp.zeros_like(p_film0))
+            *_, drops, _ = stream_traverse_stats(dev["tstream"], o0, d0, jnp.inf)
+            if int(drops) > 0:
+                from tpu_pbrt.utils.error import Warning as _W
+
+                _W(
+                    f"stream tracer dropped {int(drops)} traversal pairs to "
+                    "capacity on the camera wave — the render may have false "
+                    "misses; lower TPU_PBRT_CHUNK or raise accel/stream.py "
+                    "_sizes()"
+                )
         completed_fraction = chunks_done / max(n_chunks, 1)
         rays = prev_rays + int(sum(int(r) for r in ray_counts))
         STATS.counter("Integrator/Rays traced", rays)

@@ -896,13 +896,14 @@ def compile_scene(api) -> CompiledScene:
 
     # -- device upload ---------------------------------------------------
     # One acceleration structure only (VERDICT r1 weak #4: no duplicate
-    # geometry in HBM). The packet/MXU two-level treelet BVH is the
-    # TPU-shaped default (accel/packet.py); scenes at or below
-    # BRUTE_MAX_TRIS skip the hierarchy and brute-force all triangles in
-    # one feature matmul. TPU_PBRT_BVH=wide|binary selects the legacy
-    # per-ray walks for A/B comparison. tri_verts is padded (degenerate
-    # rows) so fixed-size leaf slices stay in bounds; interaction gathers
-    # never index the padding (prim < n_tris).
+    # geometry in HBM). The stream (sort/compaction wavefront) tracer over
+    # the two-level treelet BVH is the TPU-shaped default (accel/stream.py
+    # — coherence-independent, sized for incoherent bounce waves); scenes
+    # at or below BRUTE_MAX_TRIS skip the hierarchy and brute-force all
+    # triangles in one feature matmul. TPU_PBRT_BVH=packet|wide|binary
+    # selects the other walkers for A/B comparison. tri_verts is padded
+    # (degenerate rows) so fixed-size leaf slices stay in bounds;
+    # interaction gathers never index the padding (prim < n_tris).
     import os as _os
 
     from tpu_pbrt.accel.wide import build_wide, pad_tri_verts
@@ -922,7 +923,7 @@ def compile_scene(api) -> CompiledScene:
         "world_radius": jnp.float32(wradius),
         "n_lights": jnp.int32(n_lights if light_rows else 0),
     }
-    accel_kind = _os.environ.get("TPU_PBRT_BVH", "packet")
+    accel_kind = _os.environ.get("TPU_PBRT_BVH", "stream")
     if accel_kind == "binary":
         dev["bvh"] = bvh_as_device_dict(bvh)
     elif accel_kind == "wide":
@@ -936,8 +937,14 @@ def compile_scene(api) -> CompiledScene:
                 "feat": jnp.asarray(tri_feature_weights(verts, wcenter)),
                 "center": jnp.asarray(wcenter, jnp.float32),
             }
-        else:
+        elif accel_kind == "packet":
             dev["tpack"] = build_treelet_pack(verts, bvh)
+        else:
+            from tpu_pbrt.accel.stream import STREAM_LEAF_TRIS
+
+            dev["tstream"] = build_treelet_pack(
+                verts, bvh, leaf_tris=STREAM_LEAF_TRIS
+            )
     if has_envmap:
         dev["envmap"] = jnp.asarray(envmap, jnp.float32)
         dev["env_distr"] = env_distr
