@@ -101,6 +101,11 @@ def main():
     rays_ratio = max(result.rays_traced / max(cam_rays, 1.0), 1.0)
 
     north_star = 100.0  # Mray/s on v5e-8 (BASELINE.json north_star)
+    # sanity channel: a black render means the tracer is broken even if
+    # the ray counter ticked — Mray/s over a broken image is not a result
+    import numpy as np
+
+    img_mean = float(np.mean(np.asarray(result.image, np.float32)))
     global _last_line
     _last_line = {
         "metric": "killeroo_like_path_mray_per_sec",
@@ -110,7 +115,10 @@ def main():
         "completed_fraction": round(result.completed_fraction, 4),
         "rays_traced": result.rays_traced,
         "seconds": round(result.seconds, 2),
+        "image_mean": round(img_mean, 6),
     }
+    if not (img_mean > 1e-6):
+        _last_line["error"] = "image is black — tracer broken"
 
     mse = None
     if not os.environ.get("BENCH_SKIP_MSE"):

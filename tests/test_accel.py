@@ -317,7 +317,7 @@ def test_stream_matches_oracle():
     assert tp.n_treelets > 8
     o, d = random_rays(700, rng)
     o, d = jnp.asarray(o), jnp.asarray(d)
-    hs = stream_intersect(tp, o, d, 1e30)
+    hs = stream_intersect(tp, jnp.asarray(tris_perm), o, d, 1e30)
     hb = brute_force_intersect(jnp.asarray(tris_perm), o, d, 1e30, chunk=256)
     _oracle_compare(hs, hb)
     np.testing.assert_array_equal(
@@ -337,7 +337,57 @@ def test_stream_t_max_and_degenerate():
     tp = build_treelet_pack(tris[bvh.prim_order], bvh, leaf_tris=STREAM_LEAF_TRIS)
     o = jnp.asarray([[-5.0, 0, 0]])
     d = jnp.asarray([[1.0, 0, 0]])
-    assert int(stream_intersect(tp, o, d, 10.0).prim[0]) == 0
-    assert int(stream_intersect(tp, o, d, 4.0).prim[0]) == -1
+    tv = jnp.asarray(tris[bvh.prim_order])
+    assert int(stream_intersect(tp, tv, o, d, 10.0).prim[0]) == 0
+    assert int(stream_intersect(tp, tv, o, d, 4.0).prim[0]) == -1
     # dead rays (t_max <= 0) must report misses
-    assert int(stream_intersect(tp, o, d, -1.0).prim[0]) == -1
+    assert int(stream_intersect(tp, tv, o, d, -1.0).prim[0]) == -1
+
+
+def test_pallas_leaf_kernel_parity_interpret():
+    """The fused Pallas leaf kernel must agree with mxu.decode_outputs —
+    run in interpreter mode so the TPU production path is covered by the
+    CPU suite (a drift, e.g. a one-sided EDGE_EPS change, would otherwise
+    ship silently and only surface as a corrupted render on hardware)."""
+    from unittest import mock
+
+    import jax
+    from jax.experimental import pallas as pl
+
+    from tpu_pbrt.accel import leafkernel
+    from tpu_pbrt.accel.mxu import decode_outputs, ray_features, tri_feature_weights_raw
+
+    rng = np.random.default_rng(41)
+    B, L = 4, 64
+    tris = rng.uniform(-1, 1, (B * L, 3, 3)).astype(np.float32)
+    W = tri_feature_weights_raw(tris, np.zeros(3))
+    featT = np.ascontiguousarray(
+        W.reshape(B, L, 16, 4).transpose(0, 3, 1, 2).reshape(B, 4 * L, 16)
+    )
+    o = rng.uniform(-2, 2, (B, 128, 3)).astype(np.float32)
+    d = rng.normal(size=(B, 128, 3)).astype(np.float32)
+    d /= np.linalg.norm(d, axis=-1, keepdims=True)
+    tb = jnp.full((B, 128), 1e30, jnp.float32)
+    phi = ray_features(jnp.asarray(o), jnp.asarray(d))
+    feat_b = jnp.asarray(featT)
+
+    out = jnp.einsum("cbf,ckf->cbk", phi, feat_b, precision=jax.lax.Precision.HIGHEST)
+    t_ref, k_ref, _, _ = decode_outputs(out, L, tb)
+
+    real_call = pl.pallas_call
+
+    def interp_call(*a, **kw):
+        kw["interpret"] = True
+        return real_call(*a, **kw)
+
+    with mock.patch.object(leafkernel.pl, "pallas_call", interp_call):
+        t_pal, k_pal = leafkernel.leaf_blocks_intersect(feat_b, phi, tb)
+
+    hit_ref = np.isfinite(np.asarray(t_ref))
+    hit_pal = np.isfinite(np.asarray(t_pal))
+    np.testing.assert_array_equal(hit_ref, hit_pal)
+    assert hit_ref.sum() > 50
+    np.testing.assert_allclose(
+        np.asarray(t_pal)[hit_pal], np.asarray(t_ref)[hit_ref], rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_array_equal(np.asarray(k_pal)[hit_pal], np.asarray(k_ref)[hit_ref])

@@ -1,0 +1,97 @@
+"""Pallas TPU kernel: fused treelet-block triangle intersection.
+
+Capability match for pbrt-v3 src/shapes/triangle.cpp Triangle::Intersect
+over a leaf's triangle list (bvh.cpp's leaf loop), as the fused form of
+accel/mxu.py's feature matmul + decode_outputs.
+
+Why a kernel: the XLA path materializes the (blocks, 128, 4L) matmul
+output in HBM and then re-reads it several times through decode (slices,
+divisions, compares, argmin, take_along_axis) — measured ~4-6 ms per
+512-block chunk, the dominant cost of the stream tracer's flush phase.
+This kernel keeps the (4L, 128) product of each block entirely in VMEM,
+reduces it to the per-ray closest hit in-register, and writes only the
+(128,) winners: per-block HBM traffic drops from ~1.5 MB to ~74 KB
+(feature row + ray features + two output rows).
+
+Per grid step (one leaf block = one treelet x 128 rays):
+    out4 (4L, 128) = dot(featT (4L, 16), phiT (16, 128))   [MXU, f32]
+    u, v, t        = Moller-Trumbore ratios from out4 rows  [VPU]
+    hit            = barycentric bounds (EDGE_EPS band) & 0 < t < t_max
+    t_best, k      = masked min + argmin over the L triangles
+The b0/b1 barycentrics of the winner are NOT produced here — the stream
+tracer recomputes them once per ray from (ray, prim) at the end, which is
+cheaper than carrying them through every block merge.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_pbrt.accel.mxu import EDGE_EPS
+
+
+def _leaf_kernel(feat_ref, phi_ref, tb_ref, t_out_ref, k_out_ref, *, L: int):
+    featT = feat_ref[0]  # (4L, 16)
+    phiT = phi_ref[0]  # (16, 128)
+    out4 = jax.lax.dot_general(
+        featT, phiT,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # (4L, 128)
+    det = out4[0 * L : 1 * L]
+    udet = out4[1 * L : 2 * L]
+    vdet = out4[2 * L : 3 * L]
+    tdet = out4[3 * L : 4 * L]
+    inv = 1.0 / jnp.where(det == 0.0, 1.0, det)
+    u = udet * inv
+    v = vdet * inv
+    t = tdet * inv
+    tb = tb_ref[0]  # (1, 128) current per-ray t_max
+    hit = (
+        (det != 0.0)
+        & (u >= -EDGE_EPS)
+        & (v >= -EDGE_EPS)
+        & (u + v <= 1.0 + EDGE_EPS)
+        & (t > 0.0)
+        & (t < tb)
+    )
+    tm = jnp.where(hit, t, jnp.inf)  # (L, 128)
+    t_out_ref[0] = jnp.min(tm, axis=0, keepdims=True)
+    k_out_ref[0] = jnp.argmin(tm, axis=0, keepdims=True).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=())
+def leaf_blocks_intersect(feat_b, phi, t_b):
+    """feat_b: (B, 4L, 16) gathered treelet features; phi: (B, 128, 16)
+    ray features (re-centered); t_b: (B, 128) per-slot current t_max.
+    Returns (t_loc, k_loc): (B, 128) closest-hit distance (inf = miss,
+    always < t_b on hit) and LOCAL triangle index within the treelet —
+    the same contract as mxu.decode_outputs' first two outputs."""
+    B, fourL, _ = feat_b.shape
+    L = fourL // 4
+    phiT = jnp.swapaxes(phi, 1, 2)  # (B, 16, 128): rays on the lane dim
+    tb2 = t_b[:, None, :]  # (B, 1, 128)
+    t_loc, k_loc = pl.pallas_call(
+        partial(_leaf_kernel, L=L),
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, fourL, 16), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 16, 128), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 128), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, 128), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 128), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, 1, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, 1, 128), jnp.int32),
+        ],
+    )(feat_b, phiT, tb2)
+    return t_loc[:, 0, :], k_loc[:, 0, :]

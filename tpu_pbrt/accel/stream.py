@@ -56,7 +56,7 @@ fewer pairs), never the execution shape — the design goal for a wavefront
 path tracer whose bounce waves are inherently incoherent.
 
 The acceleration structure is the same two-level TreeletPack as the packet
-walk (accel/treelet.py) with fatter leaves (STREAM_LEAF_TRIS = 128): the
+walk (accel/treelet.py) with fatter leaves (STREAM_LEAF_TRIS = 256): the
 MXU makes triangle tests nearly free, so trading deeper trees for fatter
 matmuls moves work from the latency-bound worklist to the compute units.
 """
@@ -76,7 +76,7 @@ from tpu_pbrt.accel.treelet import TreeletPack, decode_top_leaf
 from tpu_pbrt.accel.wide import _EMPTY, slab_test
 
 #: triangles per treelet for the stream path (feature row = 4*this columns)
-STREAM_LEAF_TRIS = 128
+STREAM_LEAF_TRIS = 256
 #: rays per leaf block — the MXU matmul's row dimension
 BLOCK = 128
 #: leaf blocks processed per flush chunk (bounds transient memory: the
@@ -86,11 +86,20 @@ CHUNK = 512
 _MAX_ITERS = 1 << 16
 
 
+def _use_pallas() -> bool:
+    """Static (trace-time) switch: the fused Pallas leaf kernel runs on
+    real TPUs; CPU (tests, virtual meshes) uses the XLA einsum fallback.
+    TPU_PBRT_PALLAS=0 forces the fallback for A/B comparison."""
+    import os
+
+    if os.environ.get("TPU_PBRT_PALLAS", "1") == "0":
+        return False
+    return jax.default_backend() not in ("cpu",)
+
+
 class _SState(NamedTuple):
     t: jnp.ndarray  # (R,) current closest hit (or t_max)
     prim: jnp.ndarray  # (R,) i32 global leaf-order triangle id, -1 miss
-    b0: jnp.ndarray  # (R,)
-    b1: jnp.ndarray  # (R,)
     stk_node: jnp.ndarray  # (W + headroom,) i32 top-tree node / treelet code
     stk_ray: jnp.ndarray  # (W + headroom,) i32 ray ids
     stk_tn: jnp.ndarray  # (W + headroom,) i32 bitcast f32 entry distance
@@ -123,7 +132,6 @@ def _unbits(x):
 
 def _expand(tp: TreeletPack, boxes, o_inv, s: _SState, slab: int, w: int,
             lb: int, any_hit: bool):
-    top = tp.top
     start = jnp.maximum(s.n_stk - slab, 0)
     k = jnp.arange(slab, dtype=jnp.int32)
     valid = k < (s.n_stk - start)
@@ -137,10 +145,13 @@ def _expand(tp: TreeletPack, boxes, o_inv, s: _SState, slab: int, w: int,
     if any_hit:
         live = live & (s.prim[rid] < 0)
 
+    # NOTE: child ids must NOT ride the float box row as bitcast floats —
+    # negative int32 codes alias NaN bit patterns and TPU XLA canonicalizes
+    # NaN payloads (CPU preserves them), silently corrupting the codes
     nbox = boxes[node]  # (S, 8, 6): one packed row per pair
     nmin = nbox[..., :3]
-    nmax = nbox[..., 3:]
-    cids = top.child_idx[node]  # (S, 8)
+    nmax = nbox[..., 3:6]
+    cids = tp.top.child_idx[node]  # (S, 8)
     ray6 = o_inv[rid]  # (S, 6): origin | 1/d
     o_r = ray6[:, None, :3]
     inv_r = ray6[:, None, 3:]
@@ -242,7 +253,7 @@ def _flush(tp: TreeletPack, o, d, s: _SState, lb: int, any_hit: bool):
         return c[0] < n_blocks
 
     def chunk_body(c):
-        cstart, t, prim, b0, b1, n_tl = c
+        cstart, t, prim, n_tl = c
         bids = cstart + jnp.arange(chunk, dtype=jnp.int32)  # (CH,)
         # gather (not dynamic_slice): a slice's clamped start would
         # misalign starts against bids on the last chunk when n_blocks
@@ -260,12 +271,18 @@ def _flush(tp: TreeletPack, o, d, s: _SState, lb: int, any_hit: bool):
         t_b = jnp.where(has_ray, t[rid], -jnp.inf)  # dead slots: t<tm fails
         ctr = tp.center[tids]  # (CH, 3)
         off = tp.offset[tids]  # (CH,)
-        feat = tp.feat[tids]  # (CH, 16, 4L)
+        feat = tp.feat[tids]  # (CH, 4L, 16)
         phi = ray_features(o_b - ctr[:, None, :], d_b)
-        out = jnp.einsum(
-            "cbf,cfk->cbk", phi, feat, precision=jax.lax.Precision.HIGHEST
-        )
-        t_loc, k_loc, b0_loc, b1_loc = decode_outputs(out, L, t_b)
+        if _use_pallas():
+            from tpu_pbrt.accel.leafkernel import leaf_blocks_intersect
+
+            t_loc, k_loc = leaf_blocks_intersect(feat, phi, t_b)
+        else:
+            out = jnp.einsum(
+                "cbf,ckf->cbk", phi, feat,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            t_loc, k_loc, _, _ = decode_outputs(out, L, t_b)
         won = has_ray & jnp.isfinite(t_loc)  # t_loc < t[ray] by decode
         flat_rid = jnp.where(won, rid, R).reshape(-1)
         t2 = t.at[flat_rid].min(t_loc.reshape(-1), mode="drop")
@@ -277,17 +294,15 @@ def _flush(tp: TreeletPack, o, d, s: _SState, lb: int, any_hit: bool):
         prim2 = prim.at[sel].set(
             (off[:, None] + k_loc.astype(jnp.int32)).reshape(-1), mode="drop"
         )
-        b0_2 = b0.at[sel].set(b0_loc.reshape(-1), mode="drop")
-        b1_2 = b1.at[sel].set(b1_loc.reshape(-1), mode="drop")
         return (
-            cstart + chunk, t2, prim2, b0_2, b1_2,
+            cstart + chunk, t2, prim2,
             n_tl + jnp.sum(has_ray, dtype=jnp.int32),
         )
 
-    init = (jnp.int32(0), s.t, s.prim, s.b0, s.b1, s.n_tl)
-    _, t, prim, b0, b1, n_tl = jax.lax.while_loop(chunk_cond, chunk_body, init)
+    init = (jnp.int32(0), s.t, s.prim, s.n_tl)
+    _, t, prim, n_tl = jax.lax.while_loop(chunk_cond, chunk_body, init)
     return s._replace(
-        t=t, prim=prim, b0=b0, b1=b1,
+        t=t, prim=prim,
         n_lf=jnp.int32(0), n_tl=n_tl, iters=s.iters + 1,
     )
 
@@ -300,15 +315,13 @@ def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool) -> _SState:
     o_inv = jnp.concatenate([o, inv_d], axis=-1)  # (R, 6): one gather row
     boxes = jnp.concatenate(
         [tp.top.child_bmin, tp.top.child_bmax], axis=-1
-    )  # (N, 8, 6): one gather row
+    )  # (N, 8, 6): one gathered row per pair
 
     rid0 = jnp.arange(R, dtype=jnp.int32)
     tn0 = _bits(jnp.where(t_max > 0.0, 0.0, jnp.inf).astype(jnp.float32))
     init = _SState(
         t=jnp.asarray(t_max, jnp.float32),
         prim=jnp.full((R,), -1, jnp.int32),
-        b0=jnp.zeros((R,), jnp.float32),
-        b1=jnp.zeros((R,), jnp.float32),
         stk_node=jnp.zeros((w + s8,), jnp.int32),  # [0:R] = root
         stk_ray=jnp.zeros((w + s8,), jnp.int32).at[:R].set(rid0),
         stk_tn=jnp.full((w + s8,), _bits(jnp.float32(jnp.inf)), jnp.int32)
@@ -323,8 +336,14 @@ def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool) -> _SState:
         iters=jnp.int32(0),
     )
 
+    dead = jnp.asarray(t_max, jnp.float32) <= 0.0
+
     def cond(s: _SState):
-        return ((s.n_stk > 0) | (s.n_lf > 0)) & (s.iters < _MAX_ITERS)
+        go = ((s.n_stk > 0) | (s.n_lf > 0)) & (s.iters < _MAX_ITERS)
+        if any_hit:
+            # shadow waves stop as soon as every live ray has its hit
+            go = go & ~jnp.all((s.prim >= 0) | dead)
+        return go
 
     def body(s: _SState):
         do_flush = (s.n_lf > lb - s8) | (s.n_stk == 0)
@@ -338,21 +357,44 @@ def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool) -> _SState:
     return jax.lax.while_loop(cond, body, init)
 
 
-@partial(jax.jit, static_argnames=("any_hit",))
-def stream_intersect(tp: TreeletPack, o, d, t_max, any_hit: bool = False) -> Hit:
+@jax.jit
+def stream_intersect(tp: TreeletPack, tri_verts, o, d, t_max) -> Hit:
     """Closest hit (or first-hit source for the any-hit predicate) for a
-    flat ray batch. o, d: (R, 3); t_max scalar or (R,). Returns Hit with
-    global leaf-order triangle ids — API-compatible with bvh_intersect /
+    flat ray batch. o, d: (R, 3); t_max scalar or (R,); tri_verts the
+    shared leaf-order (T, 3, 3) vertex array the winner's barycentrics are
+    recomputed from (ONE row fetch per ray beats scattering b0/b1 per
+    tested block slot during the merge). Returns Hit with global
+    leaf-order triangle ids — API-compatible with bvh_intersect /
     wide_intersect / packet_intersect."""
     t_max = jnp.broadcast_to(jnp.asarray(t_max, jnp.float32), o.shape[:-1])
-    s = _traverse(tp, o, d, t_max, any_hit)
-    t = jnp.where(s.prim >= 0, s.t, jnp.inf)
-    return Hit(t, s.prim, s.b0, s.b1)
+    s = _traverse(tp, o, d, t_max, False)
+    hit = s.prim >= 0
+    t = jnp.where(hit, s.t, jnp.inf)
+    tv = tri_verts[jnp.maximum(s.prim, 0)]  # (R, 3, 3)
+    v0, v1, v2 = tv[:, 0], tv[:, 1], tv[:, 2]
+    e1 = v1 - v0
+    e2 = v2 - v0
+    pvec = jnp.cross(d, e2)
+    det = jnp.sum(e1 * pvec, axis=-1)
+    inv = 1.0 / jnp.where(det == 0.0, 1.0, det)
+    sv = o - v0
+    u = jnp.sum(sv * pvec, axis=-1) * inv
+    qvec = jnp.cross(sv, e1)
+    v = jnp.sum(d * qvec, axis=-1) * inv
+    b0 = jnp.where(hit, 1.0 - u - v, 0.0)
+    b1 = jnp.where(hit, u, 0.0)
+    return Hit(t, s.prim, b0, b1)
 
 
 def stream_intersect_p(tp: TreeletPack, o, d, t_max):
     """Any-hit (shadow) predicate -> bool (R,)."""
-    return stream_intersect(tp, o, d, t_max, any_hit=True).prim >= 0
+    t_max = jnp.broadcast_to(jnp.asarray(t_max, jnp.float32), o.shape[:-1])
+    return _traverse_p(tp, o, d, t_max)
+
+
+@jax.jit
+def _traverse_p(tp: TreeletPack, o, d, t_max):
+    return _traverse(tp, o, d, t_max, True).prim >= 0
 
 
 @partial(jax.jit, static_argnames=("any_hit",))

@@ -38,17 +38,23 @@ LEAF_TRIS = 64
 class TreeletPack(NamedTuple):
     """Device arrays for the two-level traversal (all jnp — every field is
     a pytree leaf so the pack passes through jit; static metadata like
-    leaf_tris is derived from shapes: feat.shape == (C, 16, 4*leaf_tris))."""
+    leaf_tris is derived from shapes: feat.shape == (C, 4*leaf_tris, 16)).
+
+    The feature layout is TRANSPOSED relative to accel/mxu.py's standalone
+    (16, 4T) weights: rows are output columns, so a leaf block feeds the
+    MXU as dot(featT (4L,16), phiT (16,128)) with the 128 rays on the lane
+    dimension — the shape the Pallas leaf kernel (accel/leafkernel.py)
+    consumes without a transpose."""
 
     top: WideBVH  # 8-wide top tree; leaf codes encode treelet ids
-    feat: jnp.ndarray  # (C, 16, 4*LEAF_TRIS) f32 MT feature matrices
+    feat: jnp.ndarray  # (C, 4*LEAF_TRIS, 16) f32 MT feature matrices
     center: jnp.ndarray  # (C, 3) f32 re-centering point per treelet
     offset: jnp.ndarray  # (C,) i32 first leaf-order triangle id
     count: jnp.ndarray  # (C,) i32 triangles in treelet
 
     @property
     def leaf_tris(self) -> int:
-        return self.feat.shape[2] // 4
+        return self.feat.shape[1] // 4
 
     @property
     def n_treelets(self) -> int:
@@ -146,10 +152,11 @@ def build_treelet_pack(
         tv.reshape(c * leaf_tris, 3, 3),
         np.repeat(center, leaf_tris, axis=0)[:, None, :],
     ).reshape(c, leaf_tris, 16, 4)
-    # (C, L, 16, 4) -> (C, 16, 4, L) -> (C, 16, 4L): columns grouped
-    # [det(L) | u*det(L) | v*det(L) | t*det(L)], matching decode_outputs
+    # (C, L, 16, 4) -> (C, 4, L, 16) -> (C, 4L, 16): rows grouped
+    # [det(L) | u*det(L) | v*det(L) | t*det(L)], matching decode_outputs'
+    # column order after the (..., f) x (k, f) contraction
     feat = np.ascontiguousarray(
-        W.transpose(0, 2, 3, 1).reshape(c, 16, 4 * leaf_tris)
+        W.transpose(0, 3, 1, 2).reshape(c, 4 * leaf_tris, 16)
     )
 
     return TreeletPack(

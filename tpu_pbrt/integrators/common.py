@@ -67,7 +67,7 @@ def scene_intersect(dev, o, d, t_max) -> Hit:
     if "tstream" in dev:
         from tpu_pbrt.accel.stream import stream_intersect
 
-        return stream_intersect(dev["tstream"], o, d, t_max)
+        return stream_intersect(dev["tstream"], dev["tri_verts"], o, d, t_max)
     if "tpack" in dev:
         from tpu_pbrt.accel.packet import packet_intersect
 
@@ -407,16 +407,19 @@ class WavefrontIntegrator:
         import os as _os
 
         # Default chunk: the stream tracer's sort/compaction steps amortize
-        # over BIG waves, so TPU dispatches carry 256k camera rays (a path
-        # chunk = ~2·maxdepth traversal waves, comfortably under the
-        # tunnel's ~60-90 s dispatch watchdog). The legacy per-ray walkers
+        # over BIG waves, so TPU dispatches carry 1M camera rays (a path
+        # chunk = ~maxdepth fused 2M-ray traversal waves at ~1s each,
+        # comfortably under the tunnel's ~60-90 s dispatch watchdog; the
+        # MAX_RAYS_PER_DISPATCH cap in accel/traverse.py applies to the
+        # legacy unrolled walkers, not the stream worklist). The legacy
+        # per-ray walkers
         # (TPU_PBRT_BVH=packet|wide|binary) are orders of magnitude slower
         # on divergent waves and keep the watchdog-safe 8k dispatches. CPU
         # (tests) prefers smaller programs to bound compile time.
         is_tpu = jax.devices()[0].platform != "cpu"
         if is_tpu:
             accel = _os.environ.get("TPU_PBRT_BVH", "stream")
-            default_chunk = (1 << 18) if accel == "stream" else (1 << 13)
+            default_chunk = (1 << 20) if accel == "stream" else (1 << 13)
         else:
             default_chunk = min(MAX_RAYS_PER_DISPATCH >> 1, 1 << 17)
         chunk = int(_os.environ.get("TPU_PBRT_CHUNK", default_chunk))

@@ -63,6 +63,13 @@ class PathIntegrator(WavefrontIntegrator):
     def li(self, dev, o, d, px, py, s):
         shape = o.shape[:-1]
         max_iters = self.max_depth + 1 + self.margin
+        # Fused-wave mode (the stream tracer's costs are per-WAVE fixed +
+        # per-pair): each iteration traces [continuation; previous bounce's
+        # shadow ray] as ONE 2R batch, halving the wave count. The shadow
+        # contribution lands one iteration late (pure pipelining — the
+        # estimator is unchanged). Scenes with null-interface materials
+        # need the multi-segment Tr walk and keep split waves.
+        fused = self.vis_segments == 1 and self.margin == 0
 
         class St(NamedTuple):
             bounce: jnp.ndarray  # scalar: loop iteration (= sampler salt base)
@@ -77,9 +84,21 @@ class PathIntegrator(WavefrontIntegrator):
             specular: jnp.ndarray
             eta_scale: jnp.ndarray
             prev_p: jnp.ndarray
+            sh_o: jnp.ndarray  # pending shadow ray (fused mode)
+            sh_d: jnp.ndarray
+            sh_dist: jnp.ndarray  # < 0: no pending shadow
+            ld_pend: jnp.ndarray  # beta-weighted NEE contribution awaiting
+            # the pending shadow's visibility
 
         def cond(st: St):
-            return (st.bounce < max_iters) & jnp.any(st.alive)
+            live = jnp.any(st.alive)
+            if fused:
+                # one extra iteration may be needed to settle the last
+                # pending shadow ray
+                return (st.bounce < max_iters + 1) & (
+                    live | jnp.any(st.sh_dist > 0.0)
+                )
+            return (st.bounce < max_iters) & live
 
         def body(st: St):
             bounce = st.bounce
@@ -91,7 +110,22 @@ class PathIntegrator(WavefrontIntegrator):
             # dead lanes traverse with t_max < 0: the root slab test fails
             # immediately, so they cost one loop iteration, not a walk
             t_max = jnp.where(alive, jnp.inf, -1.0)
-            hit = scene_intersect(dev, o, d, t_max)
+            if fused:
+                hit2 = scene_intersect(
+                    dev,
+                    jnp.concatenate([o, st.sh_o]),
+                    jnp.concatenate([d, st.sh_d]),
+                    jnp.concatenate([t_max, st.sh_dist]),
+                )
+                R = o.shape[0]
+                hit = jax.tree.map(lambda a: a[:R], hit2)
+                sh_prim = hit2.prim[R:]
+                # settle the previous bounce's NEE with its visibility
+                vis_prev = (st.sh_dist > 0.0) & (sh_prim < 0)
+                L = L + jnp.where(vis_prev[..., None], st.ld_pend, 0.0)
+                nrays = nrays + (st.sh_dist > 0.0).astype(jnp.int32)
+            else:
+                hit = scene_intersect(dev, o, d, t_max)
             nrays = nrays + alive.astype(jnp.int32)
             it = make_interaction(dev, hit, o, d)
             it.valid = it.valid & alive
@@ -136,14 +170,22 @@ class PathIntegrator(WavefrontIntegrator):
             )
             o_sh = offset_ray_origin(it.p, it.ng, ls.wi)
             sh_dist = jnp.where(do_nee, ls.dist, -1.0)  # fast-exit dead lanes
-            visible, _ = unoccluded_tr(
-                dev, o_sh, ls.wi, sh_dist, None, px, py, s, salt + DIM_LIGHT_UV + 200,
-                segments=self.vis_segments,
-            )
-            nrays = nrays + do_nee.astype(jnp.int32)
             w_l = jnp.where(ls.is_delta, 1.0, power_heuristic(1.0, ls.pdf, 1.0, bsdf_pdf))
             Ld = f * ls.li * (w_l / jnp.maximum(ls.pdf, 1e-20))[..., None]
-            L = L + jnp.where((do_nee & visible)[..., None], beta * Ld, 0.0)
+            if fused:
+                # queue the shadow ray; it rides the NEXT iteration's fused
+                # wave (the 0.999 dist margin matches unoccluded_tr)
+                sh_o_n = o_sh
+                sh_d_n = ls.wi
+                sh_dist_n = jnp.where(do_nee, sh_dist * 0.999, -1.0)
+                ld_pend_n = jnp.where(do_nee[..., None], beta * Ld, 0.0)
+            else:
+                visible, _ = unoccluded_tr(
+                    dev, o_sh, ls.wi, sh_dist, None, px, py, s,
+                    salt + DIM_LIGHT_UV + 200, segments=self.vis_segments,
+                )
+                nrays = nrays + do_nee.astype(jnp.int32)
+                L = L + jnp.where((do_nee & visible)[..., None], beta * Ld, 0.0)
 
             # ---- continuation: BSDF sample ------------------------------
             ul = uniform_float(px, py, s, salt + DIM_BSDF_LOBE)
@@ -193,9 +235,13 @@ class PathIntegrator(WavefrontIntegrator):
             beta = beta * survive_scale[..., None]
             alive = alive & ~kill
 
+            if fused:
+                pend = (sh_o_n, sh_d_n, sh_dist_n, ld_pend_n)
+            else:
+                pend = (st.sh_o, st.sh_d, st.sh_dist, st.ld_pend)
             return St(
                 bounce + 1, o, d, L, beta, alive, nrays, depth,
-                prev_pdf, specular, eta_scale, prev_p,
+                prev_pdf, specular, eta_scale, prev_p, *pend,
             )
 
         init = St(
@@ -213,6 +259,10 @@ class PathIntegrator(WavefrontIntegrator):
             specular=jnp.ones(shape, bool),
             eta_scale=jnp.ones(shape, jnp.float32),
             prev_p=o,
+            sh_o=o,
+            sh_d=d,
+            sh_dist=jnp.full(shape, -1.0, jnp.float32),
+            ld_pend=jnp.zeros(shape + (3,), jnp.float32),
         )
         out = jax.lax.while_loop(cond, body, init)
         return out.L, out.nrays

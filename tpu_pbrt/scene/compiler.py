@@ -942,9 +942,10 @@ def compile_scene(api) -> CompiledScene:
         else:
             from tpu_pbrt.accel.stream import STREAM_LEAF_TRIS
 
-            dev["tstream"] = build_treelet_pack(
-                verts, bvh, leaf_tris=STREAM_LEAF_TRIS
+            leaf_tris = int(
+                _os.environ.get("TPU_PBRT_LEAF_TRIS", STREAM_LEAF_TRIS)
             )
+            dev["tstream"] = build_treelet_pack(verts, bvh, leaf_tris=leaf_tris)
     if has_envmap:
         dev["envmap"] = jnp.asarray(envmap, jnp.float32)
         dev["env_distr"] = env_distr
