@@ -122,6 +122,77 @@ def _xform_vector(m, v):
     return v @ m[:3, :3].T
 
 
+def _screen_area_z1(cam: CompiledCamera):
+    """Area of the perspective screen window projected to the z=1 plane in
+    camera space (perspective.cpp PerspectiveCamera constructor's A)."""
+    rx, ry = cam.full_res
+    corners = jnp.asarray([[0.0, 0.0, 0.0], [float(rx), float(ry), 0.0]], jnp.float32)
+    p = _xform_point(cam.raster_to_camera, corners)
+    p = p / p[:, 2:3]
+    return jnp.abs((p[1, 0] - p[0, 0]) * (p[1, 1] - p[0, 1]))
+
+
+def camera_world_frame(cam: CompiledCamera):
+    """(origin, forward) of the camera in world space."""
+    o = _xform_point(cam.camera_to_world, jnp.zeros((1, 3), jnp.float32))[0]
+    fwd = normalize(
+        _xform_vector(cam.camera_to_world, jnp.asarray([[0.0, 0.0, 1.0]], jnp.float32))
+    )[0]
+    return o, fwd
+
+
+def project_to_raster(cam: CompiledCamera, p_world):
+    """World point -> raster coordinates + in-front/in-bounds mask (the
+    inverse of generate_rays for the pinhole perspective camera; used by
+    BDPT's t=1 camera connections and by light tracing)."""
+    w2c = jnp.linalg.inv(cam.camera_to_world)
+    c2r = jnp.linalg.inv(cam.raster_to_camera)
+    p_cam = _xform_point(w2c, p_world)
+    in_front = p_cam[..., 2] > 1e-6
+    p_safe = jnp.where(in_front[..., None], p_cam, jnp.ones_like(p_cam))
+    p_ras = _xform_point(c2r, p_safe)
+    rx, ry = cam.full_res
+    in_b = (
+        in_front
+        & (p_ras[..., 0] >= 0.0)
+        & (p_ras[..., 0] < rx)
+        & (p_ras[..., 1] >= 0.0)
+        & (p_ras[..., 1] < ry)
+    )
+    return p_ras[..., :2], in_b
+
+
+def camera_pdf_we(cam: CompiledCamera, d_world):
+    """PerspectiveCamera::Pdf_We: (pdf_pos, pdf_dir) of generating a ray
+    in direction d_world. Delta pinhole position -> pdf_pos = 1."""
+    _, fwd = camera_world_frame(cam)
+    a = _screen_area_z1(cam)
+    cos_t = jnp.maximum(jnp.sum(d_world * fwd, axis=-1), 0.0)
+    pdf_dir = jnp.where(
+        cos_t > 1e-6, 1.0 / (a * jnp.maximum(cos_t, 1e-9) ** 3), 0.0
+    )
+    return jnp.ones_like(pdf_dir), pdf_dir
+
+
+def camera_sample_wi(cam: CompiledCamera, ref_p):
+    """PerspectiveCamera::Sample_Wi for a pinhole lens: direction to the
+    camera, distance, solid-angle pdf, and the importance We carried by
+    that connection (perspective.cpp:260). Returns
+    (wi, dist, pdf, we (R,), raster_xy, in_bounds)."""
+    cam_p, fwd = camera_world_frame(cam)
+    a = _screen_area_z1(cam)
+    to_cam = cam_p - ref_p
+    dist = jnp.maximum(jnp.linalg.norm(to_cam, axis=-1), 1e-12)
+    wi = to_cam / dist[..., None]
+    cos_t = jnp.maximum(jnp.sum(-wi * fwd, axis=-1), 0.0)  # ray cam->ref
+    # pinhole: lensArea treated as 1 (delta), pdf in solid angle at ref
+    pdf = dist * dist / jnp.maximum(cos_t, 1e-9)
+    we = jnp.where(cos_t > 1e-6, 1.0 / (a * jnp.maximum(cos_t, 1e-9) ** 4), 0.0)
+    raster, in_b = project_to_raster(cam, ref_p)
+    we = jnp.where(in_b, we, 0.0)
+    return wi, dist, pdf, we, raster, in_b
+
+
 def generate_rays(cam: CompiledCamera, p_film, u_lens):
     """Batched Camera::GenerateRay.
 

@@ -37,6 +37,7 @@ class LightSample(NamedTuple):
     pdf: jnp.ndarray  # (R,) solid-angle pdf x light-pick pmf
     dist: jnp.ndarray  # (R,) shadow-ray length
     is_delta: jnp.ndarray  # (R,) delta light (no MIS vs BSDF)
+    li_idx: jnp.ndarray = None  # (R,) sampled light row (BDPT MIS needs it)
 
 
 def _spot_falloff(cos_w, cos_falloff_start, cos_total_width):
@@ -105,6 +106,27 @@ def _env_sample(dev, u1, u2):
     return wi, pdf, li
 
 
+def sample_triangle_point(tv, u1, u2):
+    """Uniform point + geometric normal on (…,3,3) triangles — shared by
+    Sample_Li, Sample_Le and BDPT's resample bookkeeping so the pdfs stay
+    bit-identical across estimators."""
+    b0, b1 = uniform_sample_triangle(u1, u2)
+    p = (
+        b0[..., None] * tv[..., 0, :]
+        + b1[..., None] * tv[..., 1, :]
+        + (1.0 - b0 - b1)[..., None] * tv[..., 2, :]
+    )
+    n = jnp.cross(tv[..., 1, :] - tv[..., 0, :], tv[..., 2, :] - tv[..., 0, :])
+    n = n / jnp.maximum(jnp.linalg.norm(n, axis=-1, keepdims=True), 1e-20)
+    return p, n
+
+
+def triangle_normal(tv):
+    """Geometric normal of (…,3,3) triangles (shared helper)."""
+    n = jnp.cross(tv[..., 1, :] - tv[..., 0, :], tv[..., 2, :] - tv[..., 0, :])
+    return n / jnp.maximum(jnp.linalg.norm(n, axis=-1, keepdims=True), 1e-20)
+
+
 def sample_light_rows(dev, li_idx, ref_p, u1, u2) -> LightSample:
     """Sample_Li for explicit light rows li_idx (R,) — no pick pmf folded."""
     lt = dev["light"]
@@ -135,16 +157,7 @@ def sample_light_rows(dev, li_idx, ref_p, u1, u2) -> LightSample:
 
     # -- area (triangle) --------------------------------------------------
     tv = dev["tri_verts"][jnp.maximum(tri, 0)]  # (R,3,3)
-    b0, b1 = uniform_sample_triangle(u1, u2)
-    p_l = (
-        b0[..., None] * tv[..., 0, :]
-        + b1[..., None] * tv[..., 1, :]
-        + (1.0 - b0 - b1)[..., None] * tv[..., 2, :]
-    )
-    e1 = tv[..., 1, :] - tv[..., 0, :]
-    e2 = tv[..., 2, :] - tv[..., 0, :]
-    n_l = jnp.cross(e1, e2)
-    n_l = n_l / jnp.maximum(jnp.linalg.norm(n_l, axis=-1, keepdims=True), 1e-20)
+    p_l, n_l = sample_triangle_point(tv, u1, u2)
     to_a = p_l - ref_p
     d2a = jnp.maximum(jnp.sum(to_a * to_a, axis=-1), 1e-12)
     dist_a = jnp.sqrt(d2a)
@@ -186,7 +199,7 @@ def sample_light_rows(dev, li_idx, ref_p, u1, u2) -> LightSample:
     is_delta = is_pt | is_spot | is_distant
 
     li = jnp.where((pdf > 0.0)[..., None], li, 0.0)
-    return LightSample(li, wi, pdf, dist, is_delta)
+    return LightSample(li, wi, pdf, dist, is_delta, li_idx)
 
 
 def sample_one_light(dev, light_distr, ref_p, u_pick, u1, u2) -> LightSample:
@@ -203,7 +216,7 @@ def sample_one_light(dev, light_distr, ref_p, u_pick, u1, u2) -> LightSample:
     else:
         li_idx, pick_pmf = light_distr.sample_discrete(u_pick)
     ls = sample_light_rows(dev, li_idx, ref_p, u1, u2)
-    return LightSample(ls.li, ls.wi, ls.pdf * pick_pmf, ls.dist, ls.is_delta)
+    return LightSample(ls.li, ls.wi, ls.pdf * pick_pmf, ls.dist, ls.is_delta, li_idx)
 
 
 def emitted_pdf(dev, light_distr, ref_p, hit_p, light_idx, n_l):
@@ -238,6 +251,133 @@ def infinite_pdf(dev, light_distr, wi):
         idx = jnp.argmax(is_env)
         pmf = light_distr.discrete_pdf(idx)
     return p * pmf
+
+
+class LeSample(NamedTuple):
+    """One sampled emission ray per lane (Light::Sample_Le, light.h)."""
+
+    li_idx: jnp.ndarray  # (R,) light row
+    pmf: jnp.ndarray  # (R,) pick pmf
+    p: jnp.ndarray  # (R,3) emission origin
+    n: jnp.ndarray  # (R,3) emission normal (light forward dir for deltas)
+    d: jnp.ndarray  # (R,3) emission direction
+    le: jnp.ndarray  # (R,3) emitted radiance/intensity
+    pdf_pos: jnp.ndarray  # (R,) area-measure position pdf (1 for deltas)
+    pdf_dir: jnp.ndarray  # (R,) solid-angle direction pdf
+    is_delta: jnp.ndarray  # (R,) delta-position light (point/spot)
+    supported: jnp.ndarray  # (R,) light type has a BDPT emission model
+
+
+def sample_le(dev, light_distr, u_pick, up1, up2, ud1, ud2) -> LeSample:
+    """Light::Sample_Le for BDPT/SPPM light subpaths (point.cpp:169,
+    spot.cpp:94, diffuse.cpp:124 Sample_Le), batched with masked type
+    dispatch. Distant/infinite lights are flagged unsupported (their
+    emission model needs scene-spanning disks; VERDICT r3 scope) — callers
+    zero those lanes and warn at compile time."""
+    from tpu_pbrt.core.sampling import (
+        cosine_sample_hemisphere,
+        uniform_sample_sphere,
+    )
+    from tpu_pbrt.core.vecmath import coordinate_system
+
+    lt = dev["light"]
+    n_lights = lt["type"].shape[0]
+    if light_distr is None:
+        li_idx = jnp.minimum((u_pick * n_lights).astype(jnp.int32), n_lights - 1)
+        pmf = jnp.full(u_pick.shape, 1.0 / n_lights, jnp.float32)
+    else:
+        li_idx, pmf = light_distr.sample_discrete(u_pick)
+    ltype = lt["type"][li_idx]
+    lp = lt["p"][li_idx]
+    lL = lt["L"][li_idx]
+    ldir = lt["dir"][li_idx]
+    cos0 = lt["cos0"][li_idx]
+    cos1 = lt["cos1"][li_idx]
+    tri = lt["tri"][li_idx]
+    twosided = lt["twosided"][li_idx]
+    area = lt["area"][li_idx]
+
+    # -- point: uniform sphere -------------------------------------------
+    d_pt = uniform_sample_sphere(ud1, ud2)
+    pdf_dir_pt = jnp.full_like(ud1, 1.0 / (4.0 * jnp.pi))
+
+    # -- spot: uniform cone of the total width (spot.cpp Sample_Le) ------
+    from tpu_pbrt.core.sampling import uniform_cone_pdf, uniform_sample_cone
+
+    d_cone = uniform_sample_cone(ud1, ud2, cos1)  # local frame, +z axis
+    s1, s2 = coordinate_system(ldir)
+    d_spot = d_cone[..., 0:1] * s1 + d_cone[..., 1:2] * s2 + d_cone[..., 2:3] * ldir
+    pdf_dir_spot = uniform_cone_pdf(cos1)
+    fall = _spot_falloff(d_cone[..., 2], cos0, cos1)
+    le_spot = lL * fall[..., None]
+
+    # -- area: uniform point on the triangle + cosine hemisphere ---------
+    # twosided lights pick the emission side with a remapped ud1 and halve
+    # the direction pdf (diffuse.cpp Sample_Le / Pdf_Le)
+    tv = dev["tri_verts"][jnp.maximum(tri, 0)]
+    p_a, n_front = sample_triangle_point(tv, up1, up2)
+    two = twosided > 0
+    flip = two & (ud1 >= 0.5)
+    ud1_a = jnp.where(two, jnp.minimum(ud1 * 2.0 % 1.0, 0.999999), ud1)
+    n_a = jnp.where(flip[..., None], -n_front, n_front)
+    d_loc = cosine_sample_hemisphere(ud1_a, ud2)
+    t1, t2 = coordinate_system(n_a)
+    d_a = d_loc[..., 0:1] * t1 + d_loc[..., 1:2] * t2 + d_loc[..., 2:3] * n_a
+    pdf_dir_a = jnp.abs(d_loc[..., 2]) / jnp.pi
+    pdf_dir_a = jnp.where(two, pdf_dir_a * 0.5, pdf_dir_a)
+    pdf_pos_a = 1.0 / jnp.maximum(area, 1e-20)
+
+    is_pt = ltype == LIGHT_POINT
+    is_spot = ltype == LIGHT_SPOT
+    is_area = ltype == LIGHT_AREA
+    supported = is_pt | is_spot | is_area
+
+    p = jnp.where(is_area[..., None], p_a, lp)
+    n = jnp.where(is_area[..., None], n_a, ldir)
+    d = jnp.where(is_area[..., None], d_a, d_pt)
+    d = jnp.where(is_spot[..., None], d_spot, d)
+    le = jnp.where(is_spot[..., None], le_spot, lL)
+    pdf_pos = jnp.where(is_area, pdf_pos_a, 1.0)
+    pdf_dir = jnp.where(is_area, pdf_dir_a, pdf_dir_pt)
+    pdf_dir = jnp.where(is_spot, pdf_dir_spot, pdf_dir)
+    is_delta = is_pt | is_spot
+    le = jnp.where(supported[..., None], le, 0.0)
+    return LeSample(li_idx, pmf, p, n, d, le, pdf_pos, pdf_dir, is_delta, supported)
+
+
+def le_pdfs(dev, li_idx, n_emit, w):
+    """Light::Pdf_Le for an emission configuration: position pdf (area
+    measure) and direction pdf (solid angle) of emitting along w from a
+    light-row li_idx whose surface normal is n_emit. Used by BDPT MIS.
+    Twosided area lights emit from either face at half the one-sided
+    cosine pdf (diffuse.cpp Pdf_Le)."""
+    from tpu_pbrt.core.sampling import uniform_cone_pdf
+
+    lt = dev["light"]
+    ltype = lt["type"][li_idx]
+    cos1 = lt["cos1"][li_idx]
+    area = lt["area"][li_idx]
+    two = lt["twosided"][li_idx] > 0
+    is_pt = ltype == LIGHT_POINT
+    is_spot = ltype == LIGHT_SPOT
+    is_area = ltype == LIGHT_AREA
+    cos_l = dot(n_emit, w)
+    pdf_area = jnp.where(
+        two, 0.5 * jnp.abs(cos_l) / jnp.pi, jnp.maximum(cos_l, 0.0) / jnp.pi
+    )
+    pdf_dir = jnp.where(is_pt, 1.0 / (4.0 * jnp.pi), 0.0)
+    pdf_dir = jnp.where(is_spot, uniform_cone_pdf(cos1), pdf_dir)
+    pdf_dir = jnp.where(is_area, pdf_area, pdf_dir)
+    pdf_pos = jnp.where(is_area, 1.0 / jnp.maximum(area, 1e-20), 1.0)
+    return pdf_pos, pdf_dir
+
+
+def light_pick_pmf(dev, light_distr, li_idx):
+    """Pick pmf of light row li_idx under the integrator's distribution."""
+    n = dev["light"]["type"].shape[0]
+    if light_distr is None:
+        return jnp.full(jnp.shape(li_idx), 1.0 / n, jnp.float32)
+    return light_distr.discrete_pdf(jnp.maximum(li_idx, 0))
 
 
 def emitted_radiance(dev, tri_light, wo_world, n_g):

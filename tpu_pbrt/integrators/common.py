@@ -452,10 +452,19 @@ class WavefrontIntegrator:
                 axis=-1,
             )
             o, d, wt = generate_rays(cam, p_film, u_lens)
-            L, nrays = self.li(dev, o, d, px, py, s)
+            out = self.li(dev, o, d, px, py, s)
+            if len(out) == 4:
+                # splat-producing integrator (BDPT t=1 / MLT / SPPM):
+                # (L, nrays, splat_xy (R,K,2), splat_val (R,K,3))
+                L, nrays, sxy, sval = out
+                sval = jnp.where(valid[..., None, None], sval, 0.0)
+                splats = (sxy.reshape(-1, 2), sval.reshape(-1, 3))
+            else:
+                L, nrays = out
+                splats = None
             nrays = jnp.sum(jnp.where(valid, nrays, 0))
             p_film = jnp.where(valid[..., None], p_film, -1e6)  # lands outside crop
-            return p_film, L, wt, nrays
+            return p_film, L, wt, nrays, splats
 
         def split_start(g0):
             """Global work index (python int, unbounded) -> int32 pair."""
@@ -477,8 +486,11 @@ class WavefrontIntegrator:
             if mesh is None:
 
                 def chunk_fn(state: FilmState, dev, start_pix, start_s):
-                    p_film, L, wt, nrays = body(dev, start_pix, start_s, chunk)
-                    return film.add_samples(state, p_film, L, wt), nrays
+                    p_film, L, wt, nrays, splats = body(dev, start_pix, start_s, chunk)
+                    state = film.add_samples(state, p_film, L, wt)
+                    if splats is not None:
+                        state = film.add_splats(state, *splats)
+                    return state, nrays
 
                 jfn = jax.jit(chunk_fn, donate_argnums=(0,))
             else:
@@ -486,8 +498,10 @@ class WavefrontIntegrator:
 
                 def per_device_fn(dev, start):
                     # start: this device's (1, 2) shard of the (n_dev, 2) pairs
-                    p_film, L, wt, nrays = body(dev, start[0, 0], start[0, 1], per_dev)
+                    p_film, L, wt, nrays, splats = body(dev, start[0, 0], start[0, 1], per_dev)
                     contrib = film.add_samples(film.init_state(), p_film, L, wt)
+                    if splats is not None:
+                        contrib = film.add_splats(contrib, *splats)
                     return contrib, nrays
 
                 step = sharded_chunk_renderer(mesh, per_device_fn)
@@ -607,10 +621,16 @@ class WavefrontIntegrator:
         STATS.distribution("Integrator/Rays per camera ray", rays / max(total, 1))
         if ckpt_path:
             save_checkpoint(ckpt_path, state, chunks_done, rays, fingerprint=fp)
-        img = film.develop(state)
+        # pbrt film.cpp WriteImage splatScale: splats (BDPT t=1, MLT, SPPM)
+        # are deposited once per SAMPLE, so the developed image divides by
+        # the number of samples actually taken — a time-boxed partial
+        # render deposited only completed_fraction of them (the rgb plane
+        # self-normalizes via its weight sum; the splat plane cannot)
+        n_splat_samples = max(spp * completed_fraction, 1e-9)
+        img = film.develop(state, splat_scale=1.0 / n_splat_samples)
         if film.filename:
             try:
-                film.write_image(state)
+                film.write_image(state, splat_scale=1.0 / n_splat_samples)
             except Exception as e:  # noqa: BLE001
                 from tpu_pbrt.utils.error import Warning as _W
 
