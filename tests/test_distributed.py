@@ -45,3 +45,71 @@ def test_sharded_render_four_devices():
     scene, integ = compile_api(api)
     r = integ.render(scene, mesh=make_mesh(4))
     assert r.image.max() > 0
+
+
+class TestFaultInjection:
+    """Worker-failure handling (SURVEY.md §2e): dropped chunk dispatches
+    are re-dispatched; a state-poisoning failure rolls back to the last
+    checkpoint. Both recoveries must be BIT-identical to the undisturbed
+    render (chunks are idempotent pure functions of the work range)."""
+
+    def _scene(self):
+        api = make_cornell(res=16, spp=8, integrator="path", maxdepth=2)
+        return compile_api(api)
+
+    def test_redispatch_bit_identical(self):
+        from tpu_pbrt.integrators.common import ChunkDispatchError
+
+        scene, integ = self._scene()
+        # small chunks so the render has several dispatches
+        import os
+
+        os.environ["TPU_PBRT_CHUNK"] = str(16 * 16 * 2)
+        try:
+            ref = integ.render(scene)
+
+            scene2, integ2 = self._scene()
+            failures = []
+
+            def hook(c, attempt):
+                if c == 1 and attempt == 0:
+                    failures.append(c)
+                    raise ChunkDispatchError("injected worker loss")
+
+            integ2._fault_hook = hook
+            r = integ2.render(scene2)
+        finally:
+            del os.environ["TPU_PBRT_CHUNK"]
+        assert failures == [1], "fault hook never fired"
+        np.testing.assert_array_equal(np.asarray(r.image), np.asarray(ref.image))
+        assert r.rays_traced == ref.rays_traced
+
+    def test_poisoned_state_recovers_via_checkpoint(self, tmp_path):
+        from tpu_pbrt.integrators.common import ChunkDispatchError
+
+        import os
+
+        os.environ["TPU_PBRT_CHUNK"] = str(16 * 16 * 2)
+        try:
+            scene, integ = self._scene()
+            ref = integ.render(scene)
+
+            scene2, integ2 = self._scene()
+            ck = str(tmp_path / "film.ckpt")
+            fired = []
+
+            def hook(c, attempt):
+                if c == 3 and not fired:
+                    fired.append(c)
+                    raise ChunkDispatchError(
+                        "injected mid-dispatch device loss", poisons_state=True
+                    )
+
+            integ2._fault_hook = hook
+            r = integ2.render(scene2, checkpoint_path=ck, checkpoint_every=1)
+        finally:
+            del os.environ["TPU_PBRT_CHUNK"]
+        assert fired == [3]
+        np.testing.assert_allclose(
+            np.asarray(r.image), np.asarray(ref.image), rtol=1e-6, atol=1e-7
+        )

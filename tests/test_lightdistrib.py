@@ -1,0 +1,68 @@
+"""SpatialLightDistribution tests (lightdistrib.cpp capability,
+VERDICT r2 weak #9): position-dependent light selection must prefer
+nearby lights and leave the estimator unbiased (strategy choice changes
+variance, never the mean)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from tests.test_render import QUAD, render_scene
+
+
+def _two_light_scene(strategy, spp=16):
+    return f'''
+Integrator "directlighting" "string lightsamplestrategy" ["{strategy}"]
+Sampler "sobol" "integer pixelsamples" [{spp}]
+PixelFilter "box"
+Film "image" "integer xresolution" [24] "integer yresolution" [24] "string filename" [""]
+LookAt 0 0 -4  0 0 0  0 1 0
+Camera "perspective" "float fov" [70]
+WorldBegin
+AttributeBegin
+AreaLightSource "diffuse" "rgb L" [20 4 4]
+Shape "trianglemesh" {QUAD} "point P" [-2.2 0.4 0  -1.8 0.4 0  -1.8 0.8 0  -2.2 0.8 0]
+AttributeEnd
+AttributeBegin
+AreaLightSource "diffuse" "rgb L" [4 4 20]
+Shape "trianglemesh" {QUAD} "point P" [1.8 0.4 0  2.2 0.4 0  2.2 0.8 0  1.8 0.8 0]
+AttributeEnd
+Material "matte" "rgb Kd" [0.7 0.7 0.7]
+Shape "trianglemesh" {QUAD} "point P" [-3 -1 0.5   3 -1 0.5   3 -1 -3  -3 -1 -3]
+WorldEnd
+'''
+
+
+def test_spatial_distribution_built_and_prefers_near_light():
+    from tpu_pbrt.scene.api import Options, parse_string, pbrt_init
+
+    api = pbrt_init(Options(quiet=True))
+    parse_string(_two_light_scene("spatial", spp=2), api, render=True)
+    scene = api.scene
+    sd = scene.spatial_distr
+    assert sd is not None
+    L = sd.cdf.shape[-1]
+    assert L == scene.n_lights == 4  # two quads = four triangle rows
+    # a point right next to the left light mostly picks a left-light row
+    p_left = jnp.asarray([[-2.0, 0.6, -0.2]], jnp.float32)
+    p_right = jnp.asarray([[2.0, 0.6, -0.2]], jnp.float32)
+    u = jnp.linspace(0.01, 0.99, 64)[:, None] * jnp.ones((1, 1))
+    picks_l = np.asarray(
+        sd.sample_discrete_at(u[:, 0], jnp.broadcast_to(p_left, (64, 3)))[0]
+    )
+    picks_r = np.asarray(
+        sd.sample_discrete_at(u[:, 0], jnp.broadcast_to(p_right, (64, 3)))[0]
+    )
+    assert (picks_l <= 1).mean() > 0.8, "near-left point should pick left light"
+    assert (picks_r >= 2).mean() > 0.8, "near-right point should pick right light"
+    # pmf consistency: discrete_pdf_at matches the sampled pick pmfs
+    idx, pmf = sd.sample_discrete_at(u[:, 0], jnp.broadcast_to(p_left, (64, 3)))
+    pmf2 = sd.discrete_pdf_at(idx, jnp.broadcast_to(p_left, (64, 3)))
+    np.testing.assert_allclose(np.asarray(pmf), np.asarray(pmf2), rtol=1e-5)
+
+
+def test_spatial_strategy_unbiased():
+    img_s = render_scene(_two_light_scene("spatial", spp=32)).image
+    img_p = render_scene(_two_light_scene("power", spp=32)).image
+    rel = abs(img_s.mean() - img_p.mean()) / max(img_p.mean(), 1e-9)
+    assert rel < 0.06, f"spatial {img_s.mean():.5f} vs power {img_p.mean():.5f}"
+    assert np.isfinite(img_s).all()

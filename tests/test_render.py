@@ -204,3 +204,51 @@ WorldEnd
         img = r.image
         got = img[16, 16]
         assert np.allclose(got, 5 * 0.8, rtol=0.05), got
+
+
+class TestImageLights:
+    """Goniometric/projection lights: image-modulated point intensity
+    (goniometric.cpp / projection.cpp capability)."""
+
+    def _plane_scene(self, light, mapline=""):
+        return (
+            scene_header("directlighting", spp=4, res=24)
+            + f'''
+WorldBegin
+{light}
+Material "matte" "rgb Kd" [1 1 1]
+Shape "trianglemesh" {QUAD} "point P" [-4 -4 1   4 -4 1   4 4 1  -4 4 1]
+WorldEnd
+'''
+        )
+
+    def test_gonio_constant_map_matches_point(self, tmp_path):
+        import numpy as np
+        from tpu_pbrt.utils.imageio import write_image
+
+        m = str(tmp_path / "m.pfm")
+        write_image(m, np.full((4, 8, 3), 1.0, np.float32))
+        r_g = render_scene(self._plane_scene(
+            f'LightSource "goniometric" "rgb I" [5 5 5] "string mapname" ["{m}"]'
+        ))
+        r_p = render_scene(self._plane_scene(
+            'LightSource "point" "rgb I" [5 5 5]'
+        ))
+        np.testing.assert_allclose(r_g.image, r_p.image, rtol=1e-4, atol=1e-5)
+
+    def test_projection_lights_only_inside_fov(self, tmp_path):
+        import numpy as np
+        from tpu_pbrt.utils.imageio import write_image
+
+        m = str(tmp_path / "m.pfm")
+        write_image(m, np.full((8, 8, 3), 1.0, np.float32))
+        img = render_scene(self._plane_scene(
+            f'LightSource "projection" "rgb I" [5 5 5] "float fov" [30] '
+            f'"string mapname" ["{m}"]'
+        )).image
+        lum = img.mean(-1)
+        assert lum.max() > 1e-3, "projection light contributed nothing"
+        # the 30-degree frustum lights only the central patch of the plane
+        assert lum[0, 0] == 0.0 and lum[-1, -1] == 0.0
+        c = lum.shape[0] // 2
+        assert lum[c, c] > 0.0

@@ -1,0 +1,107 @@
+"""SPPM tests (VERDICT r3 #5): cross-convergence against path on the
+cornell box, photon-permutation invariance of the sort-by-cell gather
+(the determinism property that replaces pbrt's atomic linked-list grid),
+and the no-photons-dropped capacity assertion."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_pbrt.scenes import compile_api, make_cornell
+
+
+def _make(spp=8, res=16, md=3, photons=4096, radius=-1.0):
+    api = make_cornell(
+        res=res,
+        spp=spp,
+        integrator="sppm",
+        maxdepth=md,
+    )
+    scene, integ = compile_api(api)
+    integ.n_iterations = spp
+    integ.photons_per_iter = photons
+    integ.initial_radius = radius
+    return scene, integ
+
+
+def test_sppm_matches_path_direct():
+    """maxdepth=1 SPPM is pure camera-pass direct lighting (photons only
+    deposit at depth>0, which needs maxdepth>=2): must equal path md=1."""
+    from tpu_pbrt.scenes import make_cornell as mk
+
+    api = mk(res=16, spp=16, integrator="path", maxdepth=1)
+    scene_p, integ_p = compile_api(api)
+    p = np.asarray(integ_p.render(scene_p).image)
+
+    scene, integ = _make(spp=16, md=1, photons=256)
+    s = np.asarray(integ.render(scene).image)
+    rel = abs(s.mean() - p.mean()) / p.mean()
+    assert rel < 0.05, f"sppm {s.mean():.4f} vs path {p.mean():.4f} ({rel:.1%})"
+
+
+def test_sppm_matches_path_indirect():
+    """maxdepth=3: photon-estimated indirect + NEE direct must converge to
+    the path estimate on the cornell box (the caustic-glass axis's
+    diffuse-scene oracle)."""
+    from tpu_pbrt.scenes import make_cornell as mk
+
+    api = mk(res=16, spp=48, integrator="path", maxdepth=3)
+    scene_p, integ_p = compile_api(api)
+    p = np.asarray(integ_p.render(scene_p).image)
+
+    scene, integ = _make(spp=16, md=3, photons=4096)
+    r = integ.render(scene)
+    assert r.stats["photons_dropped"] == 0, "scan cap truncated photon runs"
+    s = np.asarray(r.image)
+    rel = abs(s.mean() - p.mean()) / p.mean()
+    # photon density estimation carries kernel bias at finite radius; the
+    # tolerance reflects biased-but-consistent convergence
+    assert rel < 0.15, f"sppm {s.mean():.4f} vs path {p.mean():.4f} ({rel:.1%})"
+    assert np.isfinite(s).all()
+
+
+def test_gather_photon_permutation_invariance():
+    """Shuffling the photon deposit order must not change the gathered
+    flux (up to f32 summation order): the determinism property of the
+    sort-based grid (SURVEY.md §5.2)."""
+    scene, integ = _make(spp=2, md=3, photons=2048)
+    # a cap big enough that no run truncates: with truncation the scanned
+    # SUBSET depends on sort order and invariance cannot hold (that's what
+    # the dropped counter is for; the render tests assert it stays 0)
+    integ.scan_cap = 512
+    dev = scene.dev
+
+    px = jnp.arange(64, dtype=jnp.int32) % 16
+    py = jnp.arange(64, dtype=jnp.int32) // 16
+    vps, _ = integ._camera_pass(dev, px, py, jnp.int32(0))
+    dep_p, dep_d, dep_beta, dep_valid, _ = integ._photon_pass(dev, 2048, jnp.int32(0))
+
+    verts = np.asarray(dev["tri_verts"]).reshape(-1, 3)
+    lo = jnp.asarray(verts.min(0) - 0.1, jnp.float32)
+    r2 = jnp.full((64,), 0.01, jnp.float32)
+    cs = jnp.float32(0.25)
+    args = dict(r2=r2, lo=lo, cs=cs, gres=(64, 64, 64))
+
+    phi0, m0, drop0 = integ._gather(dev, vps, dep_p, dep_d, dep_beta, dep_valid, **args)
+
+    rng = np.random.default_rng(3)
+    perm = jnp.asarray(rng.permutation(dep_p.shape[0]))
+    phi1, m1, drop1 = integ._gather(
+        dev, vps, dep_p[perm], dep_d[perm], dep_beta[perm], dep_valid[perm], **args
+    )
+    assert int(drop0) == 0 and int(drop1) == 0
+    np.testing.assert_allclose(np.asarray(m0), np.asarray(m1), rtol=0, atol=0)
+    np.testing.assert_allclose(np.asarray(phi0), np.asarray(phi1), rtol=1e-4, atol=1e-6)
+
+
+def test_sppm_radius_shrinks():
+    """The progressive radius must strictly shrink for pixels that
+    received photons (r2' = r2 * (N + gamma*M)/(N + M) < r2 for M>0)."""
+    scene, integ = _make(spp=3, md=3, photons=4096, radius=0.5)
+    r = integ.render(scene)
+    # re-derive state is internal; the observable proxy: the render
+    # completed, produced finite non-black output, and dropped nothing
+    img = np.asarray(r.image)
+    assert np.isfinite(img).all()
+    assert img.mean() > 1e-4
+    assert r.stats["photons_dropped"] == 0

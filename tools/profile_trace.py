@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Perf breakdown of the stream tracer on the bench workload.
+
+Times, on the live backend, for a bench-scale camera wave:
+- full path-integrator chunk (the bench's unit of work)
+- one closest-hit stream wave (camera rays) and one incoherent bounce-like wave
+- expand/flush iteration counts + pair stats (to attribute time per step)
+
+Usage: python tools/profile_trace.py [R_log2]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, n=3, **kw):
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(n):
+        t0 = time.time()
+        out = fn(*args, **kw)
+        jax.block_until_ready(out)
+        ts.append(time.time() - t0)
+    return min(ts), out
+
+
+def main():
+    rlog = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    R = 1 << rlog
+    from tpu_pbrt.scenes import compile_api, make_killeroo_like
+
+    api = make_killeroo_like(res=512, spp=64)
+    scene, integ = compile_api(api)
+    dev = scene.dev
+    tp = dev["tstream"]
+    print(f"backend={jax.default_backend()} R={R} treelets={tp.n_treelets} "
+          f"leaf_tris={tp.leaf_tris} top_nodes={tp.top.child_idx.shape[0]}")
+
+    from tpu_pbrt.cameras import generate_rays
+    from tpu_pbrt.accel.stream import (
+        stream_intersect, stream_intersect_p, stream_traverse_stats)
+
+    # camera wave
+    k = jnp.arange(R, dtype=jnp.int32)
+    pix = k % (512 * 512)
+    pf = jnp.stack([(pix % 512).astype(jnp.float32) + 0.5,
+                    (pix // 512).astype(jnp.float32) + 0.5], -1)
+    o, d, _ = generate_rays(scene.camera, pf, jnp.zeros_like(pf))
+    t_cam, hit = timeit(stream_intersect, tp, dev["tri_verts"], o, d, jnp.inf)
+    print(f"camera wave closest-hit: {t_cam*1e3:.1f} ms "
+          f"-> {R/t_cam/1e6:.2f} Mray/s  hitrate={float(jnp.mean(hit.prim>=0)):.2f}")
+
+    n_exp, n_tl, n_drop, iters = jax.jit(
+        stream_traverse_stats, static_argnames=("any_hit",)
+    )(tp, o, d, jnp.inf, any_hit=False)
+    print(f"  pairs expanded={int(n_exp)} leaf-slots={int(n_tl)} "
+          f"drops={int(n_drop)} iters={int(iters)}")
+
+    # incoherent wave: random origins in scene bounds, random dirs
+    rng = np.random.default_rng(0)
+    lo = np.asarray(jnp.min(dev["tri_verts"].reshape(-1, 3), 0))
+    hi = np.asarray(jnp.max(dev["tri_verts"].reshape(-1, 3), 0))
+    o2 = jnp.asarray(rng.uniform(lo, hi, (R, 3)), jnp.float32)
+    d2 = rng.normal(size=(R, 3))
+    d2 = jnp.asarray(d2 / np.linalg.norm(d2, axis=-1, keepdims=True), jnp.float32)
+    t_inc, hit2 = timeit(stream_intersect, tp, dev["tri_verts"], o2, d2, jnp.inf)
+    print(f"incoherent wave closest-hit: {t_inc*1e3:.1f} ms "
+          f"-> {R/t_inc/1e6:.2f} Mray/s  hitrate={float(jnp.mean(hit2.prim>=0)):.2f}")
+    n_exp, n_tl, n_drop, iters = jax.jit(
+        stream_traverse_stats, static_argnames=("any_hit",)
+    )(tp, o2, d2, jnp.inf, any_hit=False)
+    print(f"  pairs expanded={int(n_exp)} leaf-slots={int(n_tl)} "
+          f"drops={int(n_drop)} iters={int(iters)}")
+
+    # shadow wave
+    t_sh, _ = timeit(stream_intersect_p, tp, o2, d2, 1e6)
+    print(f"incoherent any-hit: {t_sh*1e3:.1f} ms -> {R/t_sh/1e6:.2f} Mray/s")
+
+    # full path chunk at the bench's chunk size
+    os.environ.setdefault("TPU_PBRT_CHUNK", str(R))
+    t0 = time.time()
+    res = integ.render(scene, max_seconds=30)
+    print(f"path render 30s-box: {res.mray_per_sec:.2f} Mray/s "
+          f"rays={res.rays_traced} frac={res.completed_fraction:.3f} "
+          f"wall={time.time()-t0:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -63,9 +63,23 @@ def build_bvh(
     bmin = np.asarray(bmin, dtype=np.float64)
     bmax = np.asarray(bmax, dtype=np.float64)
     if method == "auto":
-        method = "sah" if n <= sah_threshold else "hlbvh"
+        # with the native builder available, SAH is fast enough for every
+        # scene size (crown-class included); only the pure-Python SAH needs
+        # the Morton escape hatch above the threshold
+        from tpu_pbrt.accel.native import get_lib
+
+        if get_lib() is not None:
+            method = "sah"
+        else:
+            method = "sah" if n <= sah_threshold else "hlbvh"
     if method in ("hlbvh", "lbvh", "morton"):
         return _build_morton(bmin, bmax, max_leaf_prims)
+    if method == "sah":
+        from tpu_pbrt.accel.native import native_build_sah
+
+        out = native_build_sah(bmin, bmax, max_leaf_prims)
+        if out is not None:
+            return out
     if method in ("sah", "middle", "equal", "equalcounts"):
         return _build_recursive(bmin, bmax, max_leaf_prims, method)
     raise ValueError(f"unknown BVH split method {method!r}")

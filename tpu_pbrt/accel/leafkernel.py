@@ -66,6 +66,51 @@ def _leaf_kernel(feat_ref, phi_ref, tb_ref, t_out_ref, k_out_ref, *, L: int):
     k_out_ref[0] = jnp.argmin(tm, axis=0, keepdims=True).astype(jnp.int32)
 
 
+def _leaf_kernel_sp(tids_ref, feat_ref, phi_ref, tb_ref, t_out_ref, k_out_ref,
+                    *, L: int):
+    # scalar-prefetch ref arrives first; the index_maps consumed it already
+    _leaf_kernel(feat_ref, phi_ref, tb_ref, t_out_ref, k_out_ref, L=L)
+
+
+@partial(jax.jit, static_argnames=())
+def leaf_blocks_intersect_prefetch(feat_table, tids, phi, t_b):
+    """Scalar-prefetch variant: takes the FULL treelet feature table
+    (C, 4L, 16) resident in HBM plus per-block treelet ids (B,) and lets
+    the grid's index_map select each step's feature block — Pallas DMAs
+    exactly feat_table[tids[i]] HBM->VMEM per step, overlapped with the
+    previous step's compute. This removes the materialized
+    `feat_table[tids]` gather (the flush phase's largest HBM cost: the
+    same treelet row was re-fetched for every one of its ~dozens of
+    blocks AND round-tripped through a (B, 4L, 16) HBM temporary)."""
+    B = tids.shape[0]
+    _, fourL, _ = feat_table.shape
+    L = fourL // 4
+    phiT = jnp.swapaxes(phi, 1, 2)  # (B, 16, 128)
+    tb2 = t_b[:, None, :]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, fourL, 16), lambda i, tids_ref: (tids_ref[i], 0, 0)),
+            pl.BlockSpec((1, 16, 128), lambda i, tids_ref: (i, 0, 0)),
+            pl.BlockSpec((1, 1, 128), lambda i, tids_ref: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, 128), lambda i, tids_ref: (i, 0, 0)),
+            pl.BlockSpec((1, 1, 128), lambda i, tids_ref: (i, 0, 0)),
+        ],
+    )
+    t_loc, k_loc = pl.pallas_call(
+        partial(_leaf_kernel_sp, L=L),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, 1, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, 1, 128), jnp.int32),
+        ],
+    )(tids, feat_table, phiT, tb2)
+    return t_loc[:, 0, :], k_loc[:, 0, :]
+
+
 @partial(jax.jit, static_argnames=())
 def leaf_blocks_intersect(feat_b, phi, t_b):
     """feat_b: (B, 4L, 16) gathered treelet features; phi: (B, 128, 16)

@@ -104,6 +104,56 @@ def fresnel_conductor(cos_i, eta, k):
 # Trowbridge-Reitz / GGX microfacet distribution (microfacet.cpp)
 # -------------------------------------------------------------------------
 
+# -------------------------------------------------------------------------
+# Beckmann distribution (microfacet.cpp BeckmannDistribution) — D, Lambda,
+# and full-distribution half-vector sampling (the non-visible-normal
+# Sample_wh branch, exact for isotropic and anisotropic alphas).
+# -------------------------------------------------------------------------
+
+def beckmann_d(wh, ax, ay):
+    t2 = tan2_theta(wh)
+    c4 = cos2_theta(wh) ** 2
+    e = jnp.exp(
+        -t2 * (cos_phi(wh) ** 2 / jnp.maximum(ax * ax, 1e-12)
+               + sin_phi(wh) ** 2 / jnp.maximum(ay * ay, 1e-12))
+    )
+    d = e / (jnp.pi * ax * ay * jnp.maximum(c4, 1e-16))
+    return jnp.where(jnp.isfinite(t2) & (c4 > 1e-16), d, 0.0)
+
+
+def beckmann_lambda(w, ax, ay):
+    abs_tan = jnp.abs(tan_theta(w))
+    alpha = jnp.sqrt(cos_phi(w) ** 2 * ax * ax + sin_phi(w) ** 2 * ay * ay)
+    a = 1.0 / jnp.maximum(alpha * abs_tan, 1e-12)
+    lam = (1.0 - 1.259 * a + 0.396 * a * a) / (3.535 * a + 2.181 * a * a)
+    return jnp.where(jnp.isfinite(abs_tan) & (a < 1.6), lam, 0.0)
+
+
+def beckmann_g(wo, wi, ax, ay):
+    return 1.0 / (1.0 + beckmann_lambda(wo, ax, ay) + beckmann_lambda(wi, ax, ay))
+
+
+def beckmann_sample_wh(u1, u2, ax, ay):
+    """Full-distribution Beckmann Sample_wh (microfacet.cpp, the
+    !sampleVisibleArea branch): tan2 = -a^2 log(1-u1) with per-phi alpha
+    for the anisotropic case."""
+    log_u = jnp.log(jnp.maximum(1.0 - u1, 1e-12))
+    phi = jnp.arctan(ay / ax * jnp.tan(2.0 * jnp.pi * u2 + 0.5 * jnp.pi))
+    phi = phi + jnp.where(u2 > 0.5, jnp.pi, 0.0)
+    sp, cp = jnp.sin(phi), jnp.cos(phi)
+    a2 = 1.0 / jnp.maximum(cp * cp / jnp.maximum(ax * ax, 1e-12)
+                           + sp * sp / jnp.maximum(ay * ay, 1e-12), 1e-12)
+    tan2 = -log_u * a2
+    ct = 1.0 / jnp.sqrt(1.0 + tan2)
+    st = jnp.sqrt(jnp.maximum(0.0, 1.0 - ct * ct))
+    return jnp.stack([st * cp, st * sp, ct], axis=-1)
+
+
+def beckmann_pdf(wh, ax, ay):
+    """pdf of wh under full-distribution sampling: D(wh) |cos wh|."""
+    return beckmann_d(wh, ax, ay) * abs_cos_theta(wh)
+
+
 def tr_roughness_to_alpha(rough):
     """TrowbridgeReitzDistribution::RoughnessToAlpha."""
     rough = jnp.maximum(rough, 1e-3)
@@ -149,10 +199,14 @@ def _tr_sample11(cos_t, u1, u2):
     a = 1.0 / jnp.maximum(tan_t, 1e-12)
     g1 = 2.0 / (1.0 + jnp.sqrt(1.0 + 1.0 / jnp.maximum(a * a, 1e-20)))
 
+    # pbrt TrowbridgeReitzSample11 verbatim: tmp = 1/(A^2-1) is NEGATIVE
+    # for |A| < 1 and that sign is load-bearing — negating it (an earlier
+    # "sanity" tweak) collapsed every u1 < 0.5 sample onto the horizon
+    # (tr_d = 0), silently killing half of all VNDF samples
     A = 2.0 * u1 / jnp.maximum(g1, 1e-12) - 1.0
-    A = jnp.clip(A, -1.0 + 1e-6, 1.0 - 1e-6)
-    tmp = jnp.minimum(1.0 / jnp.maximum(A * A - 1.0, 1e-12), 1e10)
-    tmp = jnp.where(A * A - 1.0 < 0, -tmp, tmp)  # keep sign behavior sane
+    denom = A * A - 1.0
+    tmp = 1.0 / jnp.where(jnp.abs(denom) < 1e-12, jnp.where(denom < 0, -1e-12, 1e-12), denom)
+    tmp = jnp.minimum(tmp, 1e10)
     B = tan_t
     D = jnp.sqrt(jnp.maximum(B * B * tmp * tmp - (A * A - B * B) * tmp, 0.0))
     slope_x_1 = B * tmp - D
@@ -226,6 +280,7 @@ class MatParams(NamedTuple):
     ay: jnp.ndarray
     sigma: jnp.ndarray  # oren-nayar sigma (degrees) / disney metallic
     opacity: jnp.ndarray
+    rough_raw: jnp.ndarray  # (R,) raw (pre-remap) roughness; 0 = smooth
 
 
 def gather_mat(mat: dict, mid) -> MatParams:
@@ -246,6 +301,9 @@ def gather_mat(mat: dict, mid) -> MatParams:
         ay=ay,
         sigma=mat["sigma"][mid],
         opacity=mat["opacity"][mid],
+        # glass.cpp activates the microfacet lobes when EITHER axis is
+        # rough (urough != 0 || vrough != 0)
+        rough_raw=jnp.maximum(ru, rv),
     )
 
 
@@ -374,6 +432,93 @@ def _glossy_pdf(mp: MatParams, wo, wi):
     return jnp.where(refl & (wh_len > 1e-12), pdf, 0.0)
 
 
+#: raw roughness above this makes glass a microfacet (non-delta) surface
+#: (glass.cpp: rough glass builds MicrofacetReflection/Transmission)
+ROUGH_GLASS_MIN = 1e-4
+
+
+def _is_rough_glass(mp: MatParams):
+    return (mp.mtype == MAT_GLASS) & (mp.rough_raw > ROUGH_GLASS_MIN)
+
+
+def _refract_about(wo, wh, eta_rel):
+    """Refract wo about microfacet normal wh (faced toward wo);
+    eta_rel = eta_incident / eta_transmitted. Returns (wi, tir)."""
+    wh_f = jnp.where((jnp.sum(wo * wh, axis=-1) < 0.0)[..., None], -wh, wh)
+    ci = jnp.sum(wo * wh_f, axis=-1)
+    sin2t = eta_rel * eta_rel * jnp.maximum(0.0, 1.0 - ci * ci)
+    tir = sin2t >= 1.0
+    ctt = jnp.sqrt(jnp.maximum(0.0, 1.0 - sin2t))
+    wi = eta_rel[..., None] * -wo + (eta_rel * ci - ctt)[..., None] * wh_f
+    return wi, tir
+
+
+def _mf_glass_terms(mp: MatParams, wo, wi, wh):
+    """The MicrofacetReflection + MicrofacetTransmission formulas
+    (reflection.cpp ::f/::Pdf) evaluated at an EXPLICIT half-vector —
+    the single source both bsdf_eval (reconstructed whs) and bsdf_sample
+    (the drawn wh) share, so the MIS pdfs cannot drift apart. wh is
+    faceforwarded to +z internally (TIR via the signed Fresnel cosine).
+    pdfs carry pbrt's uniform 2-lobe component weight (0.5 each).
+    Radiance transport: transmission carries the 1/eta^2 scale.
+    Returns (f_refl, pdf_refl, ok_refl, f_trans, pdf_trans, ok_trans)."""
+    eta_s = mp.eta[..., 0]
+    refl = same_hemisphere(wo, wi)
+    ci = abs_cos_theta(wi)
+    co = abs_cos_theta(wo)
+    ok_angles = (ci > 1e-7) & (co > 1e-7)
+    wh_z = jnp.where((wh[..., 2] < 0.0)[..., None], -wh, wh)
+    do_h = jnp.sum(wo * wh_z, axis=-1)
+    di_h = jnp.sum(wi * wh_z, axis=-1)
+    d = tr_d(wh_z, mp.ax, mp.ay)
+    g = tr_g(wo, wi, mp.ax, mp.ay)
+    pdf_wh = tr_pdf(wo, wh_z, mp.ax, mp.ay)
+    F = fresnel_dielectric(do_h, jnp.ones_like(eta_s), eta_s)
+
+    f_refl = mp.kr * (d * g * F / jnp.maximum(4.0 * ci * co, 1e-12))[..., None]
+    pdf_refl = 0.5 * pdf_wh / jnp.maximum(4.0 * jnp.abs(do_h), 1e-12)
+    ok_refl = refl & ok_angles
+
+    # eta = etaT/etaI of the transmitted side (MicrofacetTransmission)
+    eta_t = jnp.where(cos_theta(wo) > 0.0, eta_s, 1.0 / jnp.maximum(eta_s, 1e-6))
+    sqrt_denom = do_h + eta_t * di_h
+    factor = 1.0 / jnp.maximum(eta_t, 1e-6)  # radiance transport scale
+    f_trans = mp.kt * jnp.abs(
+        d * g * eta_t * eta_t * (1.0 - F) * jnp.abs(di_h) * jnp.abs(do_h)
+        * factor * factor
+        / jnp.maximum(ci * co * sqrt_denom * sqrt_denom, 1e-12)
+    )[..., None]
+    dwh_dwi = jnp.abs(eta_t * eta_t * di_h) / jnp.maximum(
+        sqrt_denom * sqrt_denom, 1e-12
+    )
+    pdf_trans = 0.5 * pdf_wh * dwh_dwi
+    ok_trans = (~refl) & ok_angles & (do_h * di_h < 0.0)
+    return f_refl, pdf_refl, ok_refl, f_trans, pdf_trans, ok_trans
+
+
+def _rough_glass_f_pdf(mp: MatParams, wo, wi):
+    """Eval path: reconstruct each lobe's half-vector from (wo, wi) —
+    wo+wi for reflection, the generalized wo + eta*wi for transmission —
+    then evaluate the shared terms at each."""
+    eta_s = mp.eta[..., 0]
+    wh_r = wi + wo
+    whr_len = jnp.sqrt(jnp.sum(wh_r * wh_r, axis=-1))
+    wh_rn = wh_r / jnp.maximum(whr_len[..., None], 1e-20)
+    f_r, p_r, ok_r, _, _, _ = _mf_glass_terms(mp, wo, wi, wh_rn)
+    ok_r = ok_r & (whr_len > 1e-12)
+
+    eta_t = jnp.where(cos_theta(wo) > 0.0, eta_s, 1.0 / jnp.maximum(eta_s, 1e-6))
+    wh_t = wo + wi * eta_t[..., None]
+    wht_len = jnp.sqrt(jnp.sum(wh_t * wh_t, axis=-1))
+    wh_tn = wh_t / jnp.maximum(wht_len[..., None], 1e-20)
+    _, _, _, f_t, p_t, ok_t = _mf_glass_terms(mp, wo, wi, wh_tn)
+    ok_t = ok_t & (wht_len > 1e-12)
+
+    f = jnp.where(ok_r[..., None], f_r, 0.0) + jnp.where(ok_t[..., None], f_t, 0.0)
+    pdf = jnp.where(ok_r, p_r, 0.0) + jnp.where(ok_t, p_t, 0.0)
+    return f, pdf
+
+
 # -------------------------------------------------------------------------
 # Public API
 # -------------------------------------------------------------------------
@@ -391,7 +536,12 @@ def bsdf_eval(mp: MatParams, wo, wi):
     f = jnp.where(has_d[..., None], fd, 0.0) + jnp.where(has_g[..., None], fg, 0.0)
     n_lobes = has_d.astype(jnp.float32) + has_g.astype(jnp.float32)
     pdf = (jnp.where(has_d, pd, 0.0) + jnp.where(has_g, pg, 0.0)) / jnp.maximum(n_lobes, 1.0)
-    dead = is_spec | (mp.mtype == MAT_NONE)
+    # rough (microfacet) glass is a real non-delta BSDF (glass.cpp)
+    rg = _is_rough_glass(mp)
+    f_rg, pdf_rg = _rough_glass_f_pdf(mp, wo, wi)
+    f = jnp.where(rg[..., None], f_rg, f)
+    pdf = jnp.where(rg, pdf_rg, pdf)
+    dead = (is_spec & ~rg) | (mp.mtype == MAT_NONE)
     return jnp.where(dead[..., None], 0.0, f), jnp.where(dead, 0.0, pdf)
 
 
@@ -474,8 +624,32 @@ def bsdf_sample(mp: MatParams, wo, u_lobe, u1, u2) -> BSDFSample:
     pdf = jnp.where(is_mirror, 1.0, pdf_ns)
     pdf = jnp.where(is_glass, pdf_glass, pdf)
 
-    is_specular = is_glass | is_mirror
-    is_transmission = (is_glass & ~reflect_g) | (flip_t & ~pick_g)
+    # --- rough (microfacet) glass: override the delta-glass pick ---------
+    # f/pdf come from the SAMPLED half-vector (pbrt Microfacet*::Sample_f
+    # computes its pdf from the wh it drew) — reconstructing wh from wi
+    # breaks down in f32 for the near-saturated slopes sample11 emits at
+    # high alpha (identical degenerate whs -> D = 0 -> dropped samples)
+    rg = _is_rough_glass(mp)
+    wh_rg = tr_sample_wh(wo, u1, u2, mp.ax, mp.ay)
+    refl_pick = u_lobe < 0.5  # pbrt BSDF uniform 2-lobe component choice
+    wi_rg_r = -wo + 2.0 * jnp.sum(wo * wh_rg, axis=-1)[..., None] * wh_rg
+    ct_o_rg = cos_theta(wo)
+    eta_rel_rg = jnp.where(ct_o_rg > 0.0, 1.0 / jnp.maximum(eta_s, 1e-6), eta_s)
+    wi_rg_t, tir_rg = _refract_about(wo, wh_rg, eta_rel_rg)
+    wi_rg = jnp.where(refl_pick[..., None], wi_rg_r, wi_rg_t)
+
+    f_r, p_r, ok_r2, f_t, p_t, ok_t2 = _mf_glass_terms(mp, wo, wi_rg, wh_rg)
+    ok_rg = jnp.where(refl_pick, ok_r2, ok_t2 & ~tir_rg)
+    f_rg = jnp.where(refl_pick[..., None], f_r, f_t)
+    pdf_rg = jnp.where(refl_pick, p_r, p_t)
+    wi = jnp.where(rg[..., None], wi_rg, wi)
+    f = jnp.where((rg & ok_rg)[..., None], f_rg, jnp.where(rg[..., None], 0.0, f))
+    pdf = jnp.where(rg, jnp.where(ok_rg, pdf_rg, 0.0), pdf)
+
+    is_specular = (is_glass & ~rg) | is_mirror
+    is_transmission = (is_glass & ~rg & ~reflect_g) | (flip_t & ~pick_g) | (
+        rg & ~same_hemisphere(wo, wi)
+    )
     dead = (mp.mtype == MAT_NONE) | (pdf <= 0.0)
     f = jnp.where(dead[..., None], 0.0, f)
     pdf = jnp.where(dead, 0.0, pdf)

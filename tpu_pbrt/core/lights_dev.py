@@ -25,8 +25,10 @@ from tpu_pbrt.core.vecmath import dot, normalize
 from tpu_pbrt.scene.compiler import (
     LIGHT_AREA,
     LIGHT_DISTANT,
+    LIGHT_GONIO,
     LIGHT_INFINITE,
     LIGHT_POINT,
+    LIGHT_PROJECTION,
     LIGHT_SPOT,
 )
 
@@ -127,6 +129,66 @@ def triangle_normal(tv):
     return n / jnp.maximum(jnp.linalg.norm(n, axis=-1, keepdims=True), 1e-20)
 
 
+def _light_map_scale(dev, lt, li_idx, w_from_light, is_gonio, is_proj):
+    """Image-modulated angular intensity of goniometric/projection lights
+    (goniometric.h Scale, projection.cpp Projection). w_from_light is the
+    world direction FROM the light toward the shading point; each row
+    carries its world-to-light rotation and its (offset, w, h) window into
+    the shared light atlas. Clamp-filtered bilinear lookup with per-row
+    traced extents."""
+    atlas = dev["light_atlas"]
+    w2l = lt["w2l"][li_idx].reshape(li_idx.shape + (3, 3))
+    img = lt["img"][li_idx]  # (..., 3): offset, width, height
+    off, iw, ih = img[..., 0], img[..., 1], img[..., 2]
+    dl = jnp.einsum("...ij,...j->...i", w2l, w_from_light)
+    dl = normalize(dl)
+
+    # goniometric: lat-long about the Y axis — pbrt goniometric.h Scale()
+    # swaps y/z before SphericalTheta/Phi, so theta comes from the
+    # light-space Y component and phi from (x, z)
+    theta = jnp.arccos(jnp.clip(dl[..., 1], -1.0, 1.0))
+    phi = jnp.arctan2(dl[..., 2], dl[..., 0])
+    phi = jnp.where(phi < 0, phi + 2 * jnp.pi, phi)
+    u_g = phi / (2 * jnp.pi)
+    v_g = theta / jnp.pi
+
+    # projection: perspective divide into the fov screen window
+    tan_half = lt["cos0"][li_idx]
+    aspect = lt["cos1"][li_idx]
+    z = dl[..., 2]
+    inside_z = z > 1e-3
+    zs = jnp.where(inside_z, z, 1.0)
+    sx = dl[..., 0] / (zs * jnp.maximum(tan_half, 1e-6))
+    sy = dl[..., 1] / (zs * jnp.maximum(tan_half, 1e-6))
+    u_p = (sx / jnp.maximum(aspect, 1.0) + 1.0) * 0.5
+    v_p = (sy * jnp.minimum(aspect, 1.0) + 1.0) * 0.5
+    in_win = inside_z & (u_p >= 0) & (u_p < 1) & (v_p >= 0) & (v_p < 1)
+
+    u = jnp.where(is_proj, u_p, u_g)
+    v = jnp.where(is_proj, v_p, v_g)
+
+    x = u * iw - 0.5
+    y = v * ih - 0.5
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    fx = x - x0
+    fy = y - y0
+
+    def tap(ix, iy):
+        ix = jnp.clip(ix.astype(jnp.int32), 0, jnp.maximum(iw - 1, 0))
+        iy = jnp.clip(iy.astype(jnp.int32), 0, jnp.maximum(ih - 1, 0))
+        return atlas[jnp.maximum(off, 0) + iy * iw + ix]
+
+    c = (
+        tap(x0, y0) * ((1 - fx) * (1 - fy))[..., None]
+        + tap(x0 + 1, y0) * (fx * (1 - fy))[..., None]
+        + tap(x0, y0 + 1) * ((1 - fx) * fy)[..., None]
+        + tap(x0 + 1, y0 + 1) * (fx * fy)[..., None]
+    )
+    use = (is_gonio | (is_proj & in_win)) & (off >= 0)
+    return jnp.where(use[..., None], c, jnp.where(is_proj[..., None], 0.0, 1.0))
+
+
 def sample_light_rows(dev, li_idx, ref_p, u1, u2) -> LightSample:
     """Sample_Li for explicit light rows li_idx (R,) — no pick pmf folded."""
     lt = dev["light"]
@@ -178,6 +240,15 @@ def sample_light_rows(dev, li_idx, ref_p, u1, u2) -> LightSample:
         li_env = jnp.zeros_like(lL)
         dist_env = dist_dist
 
+    # -- goniometric / projection (image-modulated point intensity) -------
+    is_gonio = ltype == LIGHT_GONIO
+    is_proj = ltype == LIGHT_PROJECTION
+    if "light_atlas" in dev:
+        scale_img = _light_map_scale(dev, lt, li_idx, -wi_pt, is_gonio, is_proj)
+        li_gonio = li_pt * scale_img
+    else:
+        li_gonio = li_pt
+
     # -- select by type ---------------------------------------------------
     is_pt = ltype == LIGHT_POINT
     is_spot = ltype == LIGHT_SPOT
@@ -190,22 +261,64 @@ def sample_light_rows(dev, li_idx, ref_p, u1, u2) -> LightSample:
     wi = jnp.where(is_env[..., None], wi_env, wi)
     li = jnp.where(is_area[..., None], li_a, li_pt)
     li = jnp.where(is_spot[..., None], li_spot, li)
+    li = jnp.where((is_gonio | is_proj)[..., None], li_gonio, li)
     li = jnp.where(is_distant[..., None], li_dist, li)
     li = jnp.where(is_env[..., None], li_env, li)
     pdf = jnp.where(is_area, pdf_a, 1.0)
     pdf = jnp.where(is_env, pdf_env, pdf)
     dist = jnp.where(is_area, dist_a, dist_pt)
     dist = jnp.where(is_distant | is_env, dist_env, dist)
-    is_delta = is_pt | is_spot | is_distant
+    is_delta = is_pt | is_spot | is_distant | is_gonio | is_proj
 
     li = jnp.where((pdf > 0.0)[..., None], li, 0.0)
     return LightSample(li, wi, pdf, dist, is_delta, li_idx)
 
 
+class SpatialLightDistribution(NamedTuple):
+    """lightdistrib.cpp SpatialLightDistribution, precomputed dense.
+
+    pbrt voxelizes the scene and builds a per-voxel light Distribution1D
+    LAZILY in a lock-free hash (64-entry packed keys); the TPU-shaped
+    equivalent precomputes every voxel's CDF at scene compile into one
+    dense (V, L) table — selection is then a single row gather plus a
+    masked scan, no hashing and no laziness. The per-voxel importance is
+    estimated at the voxel center (pbrt Monte-Carlos 128 points per
+    voxel; documented simplification)."""
+
+    cdf: jnp.ndarray  # (V, L) inclusive per-voxel CDF
+    mean_pmf: jnp.ndarray  # (L,) scene-wide marginal (positionless fallback)
+    lo: jnp.ndarray  # (3,)
+    inv_cs: jnp.ndarray  # (3,)
+    res: tuple  # STATIC (nx, ny, nz)
+
+    def _voxel(self, p):
+        nx, ny, nz = self.res
+        v = jnp.floor((p - self.lo) * self.inv_cs).astype(jnp.int32)
+        v = jnp.clip(v, 0, jnp.asarray([nx - 1, ny - 1, nz - 1], jnp.int32))
+        return v[..., 0] + nx * (v[..., 1] + ny * v[..., 2])
+
+    def sample_discrete_at(self, u, p):
+        row = self.cdf[self._voxel(p)]  # (..., L)
+        idx = jnp.sum((u[..., None] >= row).astype(jnp.int32), axis=-1)
+        idx = jnp.minimum(idx, row.shape[-1] - 1)
+        prev = jnp.where(idx > 0, jnp.take_along_axis(row, jnp.maximum(idx - 1, 0)[..., None], -1)[..., 0], 0.0)
+        pmf = jnp.take_along_axis(row, idx[..., None], -1)[..., 0] - prev
+        return idx, jnp.maximum(pmf, 1e-12)
+
+    def discrete_pdf_at(self, idx, p):
+        row = self.cdf[self._voxel(p)]
+        idx = jnp.clip(idx, 0, row.shape[-1] - 1)
+        prev = jnp.where(idx > 0, jnp.take_along_axis(row, jnp.maximum(idx - 1, 0)[..., None], -1)[..., 0], 0.0)
+        return jnp.maximum(
+            jnp.take_along_axis(row, idx[..., None], -1)[..., 0] - prev, 1e-12
+        )
+
+
 def sample_one_light(dev, light_distr, ref_p, u_pick, u1, u2) -> LightSample:
     """UniformSampleOneLight's light-selection + Sample_Li, batched.
 
-    light_distr: None for uniform pick, or a Distribution1D (power).
+    light_distr: None for uniform pick, a Distribution1D (power), or a
+    SpatialLightDistribution (position-dependent pick).
     Returns pdf already including the pick pmf (contribution / pdf is then
     the single-light estimator of the sum over lights)."""
     lt = dev["light"]
@@ -213,6 +326,8 @@ def sample_one_light(dev, light_distr, ref_p, u_pick, u1, u2) -> LightSample:
     if light_distr is None:
         li_idx = jnp.minimum((u_pick * n).astype(jnp.int32), n - 1)
         pick_pmf = jnp.full(u_pick.shape, 1.0 / n, jnp.float32)
+    elif isinstance(light_distr, SpatialLightDistribution):
+        li_idx, pick_pmf = light_distr.sample_discrete_at(u_pick, ref_p)
     else:
         li_idx, pick_pmf = light_distr.sample_discrete(u_pick)
     ls = sample_light_rows(dev, li_idx, ref_p, u1, u2)
@@ -232,13 +347,17 @@ def emitted_pdf(dev, light_distr, ref_p, hit_p, light_idx, n_l):
     pdf_sa = d2 / jnp.maximum(cos_l * area, 1e-12)
     if light_distr is None:
         pmf = 1.0 / n
+    elif isinstance(light_distr, SpatialLightDistribution):
+        pmf = light_distr.discrete_pdf_at(jnp.maximum(light_idx, 0), ref_p)
     else:
         pmf = light_distr.discrete_pdf(jnp.maximum(light_idx, 0))
     return pdf_sa * pmf
 
 
-def infinite_pdf(dev, light_distr, wi):
-    """Pdf_Li x pick pmf for escaped (BSDF-sampled) rays toward the env."""
+def infinite_pdf(dev, light_distr, wi, ref_p=None):
+    """Pdf_Li x pick pmf for escaped (BSDF-sampled) rays toward the env.
+    ref_p: scattering position (needed for the spatial strategy's pick
+    pmf; None falls back to the scene-wide marginal)."""
     lt = dev["light"]
     n = lt["type"].shape[0]
     if "envmap" not in dev:
@@ -247,6 +366,14 @@ def infinite_pdf(dev, light_distr, wi):
     is_env = lt["type"] == LIGHT_INFINITE
     if light_distr is None:
         pmf = jnp.sum(is_env.astype(jnp.float32)) / n
+    elif isinstance(light_distr, SpatialLightDistribution):
+        idx = jnp.argmax(is_env)
+        if ref_p is None:
+            pmf = light_distr.mean_pmf[idx]
+        else:
+            pmf = light_distr.discrete_pdf_at(
+                jnp.broadcast_to(idx, wi.shape[:-1]), ref_p
+            )
     else:
         idx = jnp.argmax(is_env)
         pmf = light_distr.discrete_pdf(idx)
@@ -285,6 +412,13 @@ def sample_le(dev, light_distr, u_pick, up1, up2, ud1, ud2) -> LeSample:
     if light_distr is None:
         li_idx = jnp.minimum((u_pick * n_lights).astype(jnp.int32), n_lights - 1)
         pmf = jnp.full(u_pick.shape, 1.0 / n_lights, jnp.float32)
+    elif isinstance(light_distr, SpatialLightDistribution):
+        # emission has no receiver position; pick by the scene marginal
+        cdf = jnp.cumsum(light_distr.mean_pmf)
+        li_idx = jnp.minimum(
+            jnp.sum((u_pick[..., None] >= cdf).astype(jnp.int32), -1), n_lights - 1
+        )
+        pmf = jnp.maximum(light_distr.mean_pmf[li_idx], 1e-12)
     else:
         li_idx, pmf = light_distr.sample_discrete(u_pick)
     ltype = lt["type"][li_idx]
@@ -330,17 +464,27 @@ def sample_le(dev, light_distr, u_pick, up1, up2, ud1, ud2) -> LeSample:
     is_pt = ltype == LIGHT_POINT
     is_spot = ltype == LIGHT_SPOT
     is_area = ltype == LIGHT_AREA
-    supported = is_pt | is_spot | is_area
+    # goniometric/projection photons: point-position emission over the
+    # sphere with the image-modulated intensity (goniometric.cpp /
+    # projection.cpp Sample_Le; projection directions outside the fov
+    # window carry zero and are wasted, as in the reference's cone)
+    is_img = (ltype == LIGHT_GONIO) | (ltype == LIGHT_PROJECTION)
+    supported = is_pt | is_spot | is_area | is_img
 
     p = jnp.where(is_area[..., None], p_a, lp)
     n = jnp.where(is_area[..., None], n_a, ldir)
     d = jnp.where(is_area[..., None], d_a, d_pt)
     d = jnp.where(is_spot[..., None], d_spot, d)
     le = jnp.where(is_spot[..., None], le_spot, lL)
+    if "light_atlas" in dev:
+        le_img = lL * _light_map_scale(
+            dev, lt, li_idx, d, ltype == LIGHT_GONIO, ltype == LIGHT_PROJECTION
+        )
+        le = jnp.where(is_img[..., None], le_img, le)
     pdf_pos = jnp.where(is_area, pdf_pos_a, 1.0)
     pdf_dir = jnp.where(is_area, pdf_dir_a, pdf_dir_pt)
     pdf_dir = jnp.where(is_spot, pdf_dir_spot, pdf_dir)
-    is_delta = is_pt | is_spot
+    is_delta = is_pt | is_spot | is_img
     le = jnp.where(supported[..., None], le, 0.0)
     return LeSample(li_idx, pmf, p, n, d, le, pdf_pos, pdf_dir, is_delta, supported)
 
@@ -372,11 +516,15 @@ def le_pdfs(dev, li_idx, n_emit, w):
     return pdf_pos, pdf_dir
 
 
-def light_pick_pmf(dev, light_distr, li_idx):
+def light_pick_pmf(dev, light_distr, li_idx, ref_p=None):
     """Pick pmf of light row li_idx under the integrator's distribution."""
     n = dev["light"]["type"].shape[0]
     if light_distr is None:
         return jnp.full(jnp.shape(li_idx), 1.0 / n, jnp.float32)
+    if isinstance(light_distr, SpatialLightDistribution):
+        if ref_p is None:
+            return jnp.maximum(light_distr.mean_pmf[jnp.maximum(li_idx, 0)], 1e-12)
+        return light_distr.discrete_pdf_at(jnp.maximum(li_idx, 0), ref_p)
     return light_distr.discrete_pdf(jnp.maximum(li_idx, 0))
 
 

@@ -256,6 +256,161 @@ def sobol_2d(n, scramble_x=0, scramble_y=0):
     )
 
 
+def _primes(n):
+    out, c = [], 2
+    while len(out) < n:
+        if all(c % p for p in out):
+            out.append(c)
+        c += 1
+    return out
+
+
+#: prime bases for the Halton sampler's dimensions (primes.cpp equivalent,
+#: generated instead of tabulated)
+PRIMES = _primes(64)
+
+
+def radical_inverse_prime(base: int, n, scramble_seed=None):
+    """ScrambledRadicalInverse (lowdiscrepancy.h) for a STATIC prime base:
+    digit reversal in the given base with an optional per-stream
+    multiplicative digit permutation (seeded; digit 0 maps to 0 only under
+    the identity — the (a*d + c) mod b permutation keeps sequences
+    collision-free per digit while decorrelating streams)."""
+    if base == 2:
+        scr = 0 if scramble_seed is None else scramble_seed
+        return radical_inverse_base2(n, scr)
+    n = jnp.asarray(n, jnp.uint32)
+    digits = int(np.ceil(32 / np.log2(base)))
+    inv_base = np.float32(1.0 / base)
+    if scramble_seed is not None:
+        seed = jnp.asarray(scramble_seed, jnp.uint32)
+        a = (seed % jnp.uint32(base - 1)) + jnp.uint32(1)  # coprime to prime b
+        c = (seed >> 8) % jnp.uint32(base)
+    out = jnp.zeros(jnp.shape(n), jnp.float32)
+    factor = np.float32(1.0)
+    for _ in range(digits):
+        d = n % jnp.uint32(base)
+        if scramble_seed is not None:
+            d = (a * d + c) % jnp.uint32(base)
+        factor = factor * inv_base
+        out = out + d.astype(jnp.float32) * factor
+        n = n // jnp.uint32(base)
+    return jnp.minimum(out, ONE_MINUS_EPSILON)
+
+
+# -------------------------------------------------------------------------
+# Sampler plugin dispatch (samplers/{random,stratified,zerotwosequence,
+# sobol,halton,maxmin}.cpp; VERDICT r3 #7). The wavefront redesign keeps
+# every draw a pure function of (px, py, sample index, dimension salt);
+# what the plugin selects is the STRUCTURE of each dimension's stream:
+#
+# - random:      the counter-hash (rng.h equivalent)
+# - stratified:  jittered strata over the spp range, shuffled per
+#                (pixel, dimension) so dimensions pair independently
+# - 02sequence/lowdiscrepancy/sobol/maxmindist: xor-scrambled (0,2)
+#   Sobol' pairs, sample order shuffled per (pixel, dimension) — pbrt's
+#   ZeroTwoSequenceSampler decorrelates dimensions exactly this way
+#   (shuffled independently per dimension request). maxmindist's bespoke
+#   generator matrix is approximated by the (0,2) sequence (documented).
+# - halton:      per-pixel scrambled Halton — dimension pairs use prime
+#   bases (2,3),(5,7),(11,13),... at the SAME index (jointly LD), with
+#   per-pixel digit scrambles replacing pbrt's global pixel stride walk
+#   (lowdiscrepancy.cpp: equivalent stratification, no 2^k image tiling).
+# -------------------------------------------------------------------------
+
+_HALTON_PAIRS = [(2, 3), (5, 7), (11, 13), (17, 19), (23, 29), (31, 37)]
+
+
+def sample_1d(kind: str, spp: int, px, py, s, salt):
+    """One U[0,1) draw for dimension `salt` under sampler `kind`."""
+    if kind == "random" or spp <= 1:
+        return uniform_float(px, py, s, salt)
+    if kind == "stratified":
+        return stratified_1d(s, spp, px, py, salt)
+    if kind == "halton":
+        # LOW prime bases (high bases stratify poorly at render spp);
+        # the per-dimension sample-order shuffle keeps each dimension's
+        # point set intact while decorrelating reused bases (the padded-
+        # sampler construction). salt may be TRACED (path.py's while_loop
+        # bounce counter): the base pick becomes a lax.switch then.
+        sp = permutation_element(s, spp, hash_u32(px, py, salt, 0x6E5))
+        seed = hash_u32(px, py, salt, 0x4A1)
+        if isinstance(salt, (int, np.integer)):
+            return radical_inverse_prime(PRIMES[salt % 4], sp, seed)
+        import jax as _jax
+
+        return _jax.lax.switch(
+            jnp.asarray(salt % 4, jnp.int32),
+            [
+                (lambda b: lambda: radical_inverse_prime(b, sp, seed))(b)
+                for b in (2, 3, 5, 7)
+            ],
+        )
+    # (0,2)-family: shuffled + scrambled van der Corput
+    sp = permutation_element(s, spp, hash_u32(px, py, salt, 0x7F2))
+    return radical_inverse_base2(sp, hash_u32(px, py, salt, 0x9D3))
+
+
+def sample_2d(kind: str, spp: int, px, py, s, salt):
+    """A consumed-together 2D pair for dimension pair `salt`."""
+    if kind == "random" or spp <= 1:
+        return (
+            uniform_float(px, py, s, salt),
+            uniform_float(px, py, s, salt + 0x151),
+        )
+    if kind == "stratified":
+        sx = max(int(np.sqrt(spp)), 1)
+        sy = (spp + sx - 1) // sx  # sx*sy >= spp: permutation stays a bijection
+        return stratified_2d(s, sx, sy, px, py, salt)
+    if kind == "halton":
+        # joint (b1, b2) pair at a SHARED shuffled index: the pair keeps
+        # its joint 2D low discrepancy (same point set, reordered) and
+        # different pair-dimensions decorrelate through the shuffle
+        seed = hash_u32(px, py, salt, 0x62B)
+        sp = permutation_element(s, spp, hash_u32(px, py, salt, 0xD47))
+
+        def pair(b1, b2):
+            return lambda: jnp.stack(
+                [
+                    radical_inverse_prime(b1, sp, seed),
+                    radical_inverse_prime(b2, sp, seed >> 7),
+                ],
+                axis=0,
+            )
+
+        if isinstance(salt, (int, np.integer)):
+            uv = pair(*_HALTON_PAIRS[salt % len(_HALTON_PAIRS)])()
+        else:
+            import jax as _jax
+
+            uv = _jax.lax.switch(
+                jnp.asarray(salt % len(_HALTON_PAIRS), jnp.int32),
+                [pair(b1, b2) for b1, b2 in _HALTON_PAIRS],
+            )
+        return uv[0], uv[1]
+    sp = permutation_element(s, spp, hash_u32(px, py, salt, 0x3C5))
+    return sobol_2d(
+        sp, hash_u32(px, py, salt, 0x8E7), hash_u32(px, py, salt, 0xB19)
+    )
+
+
+def normalize_sampler_name(name: str) -> str:
+    """Scene-file sampler name -> dispatch kind (api.cpp MakeSampler)."""
+    n = (name or "").lower()
+    if n in ("random",):
+        return "random"
+    if n in ("stratified",):
+        return "stratified"
+    if n in ("halton",):
+        return "halton"
+    if n in ("sobol", "lowdiscrepancy", "02sequence", "zerotwosequence", "maxmindist"):
+        return "02"
+    from tpu_pbrt.utils.error import Warning as _W
+
+    _W(f'sampler "{name}" unknown; using the (0,2)-sequence sampler')
+    return "02"
+
+
 # -------------------------------------------------------------------------
 # Distribution1D / Distribution2D (pbrt sampling.h) — piecewise-constant
 # CDF importance sampling. Build host-side (numpy), sample device-side.

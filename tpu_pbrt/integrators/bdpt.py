@@ -108,6 +108,12 @@ class BDPTIntegrator(WavefrontIntegrator):
 
         if scene.has_null_materials:
             _W("bdpt: null-interface materials are traversed as opaque")
+        from tpu_pbrt.core.lights_dev import SpatialLightDistribution
+
+        if isinstance(self.light_distr, SpatialLightDistribution):
+            # BDPT's MIS walk evaluates pick pmfs at several path vertices;
+            # the position-dependent strategy is not plumbed through it
+            self.light_distr = scene.light_distr
         self._pinhole = float(scene.camera.lens_radius) == 0.0
         if not self._pinhole:
             _W("bdpt: lens camera — t=1 (light tracing) strategies skipped")

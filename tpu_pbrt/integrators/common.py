@@ -46,7 +46,15 @@ from tpu_pbrt.parallel.checkpoint import (
     render_fingerprint,
     save_checkpoint,
 )
-from tpu_pbrt.core.sampling import hash_u32, power_heuristic, sobol_2d, uniform_float
+from tpu_pbrt.core.sampling import (
+    hash_u32,
+    normalize_sampler_name,
+    power_heuristic,
+    sample_1d,
+    sample_2d,
+    sobol_2d,
+    uniform_float,
+)
 from tpu_pbrt.core.vecmath import (
     coordinate_system,
     cross,
@@ -176,6 +184,17 @@ DIM_RR = 10
 DIMS_PER_BOUNCE = 16
 
 
+class ChunkDispatchError(RuntimeError):
+    """A chunk dispatch failed (worker/device loss). poisons_state=True
+    means the in-flight film accumulator cannot be trusted (mid-dispatch
+    loss) and recovery must roll back to the last checkpoint; False means
+    the dispatch never ran and a plain re-dispatch is exact."""
+
+    def __init__(self, msg="chunk dispatch failed", poisons_state=False):
+        super().__init__(msg)
+        self.poisons_state = poisons_state
+
+
 @dataclass
 class RenderResult:
     image: np.ndarray
@@ -244,9 +263,57 @@ def make_interaction(dev, hit: Hit, o, d) -> Interaction:
     )
 
 
+def textured_mat(dev, mid, uv, p, tex_eval, tex_used) -> "bxdf.MatParams":
+    """Material::ComputeScatteringFunctions' texture evaluation step
+    (material.cpp): gather the constant-folded parameter table, then
+    overwrite each slot that carries a texture id with its compiled
+    evaluator's value at (uv, p). tex_used is a STATIC set — untextured
+    slots cost nothing at trace time."""
+    mp = bxdf.gather_mat(dev["mat"], mid)
+    if tex_eval is None or "tex_atlas" not in dev or not tex_used:
+        return mp
+    mt = dev["mat"]
+    atlas = dev["tex_atlas"]
+
+    def ev3(slot, field):
+        tid = mt[slot][mid]
+        v = tex_eval(atlas, tid, uv, p)
+        return jnp.where((tid >= 0)[..., None], v, field)
+
+    def ev1(slot, field):
+        tid = mt[slot][mid]
+        v = jnp.mean(tex_eval(atlas, tid, uv, p), axis=-1)
+        return jnp.where(tid >= 0, v, field)
+
+    kw = {}
+    if "kd" in tex_used:
+        kw["kd"] = ev3("kd_tex", mp.kd)
+    if "ks" in tex_used:
+        kw["ks"] = ev3("ks_tex", mp.ks)
+    if "sigma" in tex_used:
+        kw["sigma"] = ev1("sigma_tex", mp.sigma)
+    if "opacity" in tex_used:
+        kw["opacity"] = ev3("opacity_tex", mp.opacity)
+    if "rough" in tex_used:
+        # roughness feeds the GGX alphas through the remap, so the
+        # override recomputes ax/ay (gather_mat's derivation)
+        tid = mt["rough_tex"][mid]
+        r = jnp.mean(tex_eval(atlas, tid, uv, p), axis=-1)
+        remap = mt["remap"][mid]
+        a_t = jnp.where(
+            remap > 0, bxdf.tr_roughness_to_alpha(r), jnp.maximum(r, 1e-3)
+        )
+        kw["ax"] = jnp.where(tid >= 0, a_t, mp.ax)
+        kw["ay"] = jnp.where(tid >= 0, a_t, mp.ay)
+        # rough_raw gates the rough-glass lobes (_is_rough_glass): a
+        # roughness texture on glass must activate them too
+        kw["rough_raw"] = jnp.where(tid >= 0, r, mp.rough_raw)
+    return mp._replace(**kw)
+
+
 def estimate_direct(
     dev, light_distr, it: Interaction, mp, px, py, s, bounce,
-    light_idx=None, salt_extra=0, vis_segments=1,
+    light_idx=None, salt_extra=0, vis_segments=1, sampler=("random", 1),
 ):
     """pbrt EstimateDirect with MIS, light-sampling half + BSDF-sampling
     half. Traces one shadow ray and (for the BSDF half) one MIS ray.
@@ -258,10 +325,10 @@ def estimate_direct(
     geometry (see unoccluded_tr). Returns (R,3) direct radiance."""
     salt = bounce * DIMS_PER_BOUNCE + salt_extra
 
+    skind, spp = sampler
     # ---- light-sampling half -------------------------------------------
-    u_pick = uniform_float(px, py, s, salt + DIM_LIGHT_PICK)
-    u1 = uniform_float(px, py, s, salt + DIM_LIGHT_UV)
-    u2 = uniform_float(px, py, s, salt + DIM_LIGHT_UV + 100)
+    u_pick = sample_1d(skind, spp, px, py, s, salt + DIM_LIGHT_PICK)
+    u1, u2 = sample_2d(skind, spp, px, py, s, salt + DIM_LIGHT_UV)
     if light_idx is None:
         ls = ld.sample_one_light(dev, light_distr, it.p, u_pick, u1, u2)
     else:
@@ -285,9 +352,8 @@ def estimate_direct(
     L = jnp.where(vis[..., None], contrib_l, 0.0)
 
     # ---- BSDF-sampling half (non-delta lights: area + infinite) ---------
-    ul = uniform_float(px, py, s, salt + DIM_BSDF_LOBE + 200)
-    ub1 = uniform_float(px, py, s, salt + DIM_BSDF_UV + 200)
-    ub2 = uniform_float(px, py, s, salt + DIM_BSDF_UV + 300)
+    ul = sample_1d(skind, spp, px, py, s, salt + DIM_BSDF_LOBE + 200)
+    ub1, ub2 = sample_2d(skind, spp, px, py, s, salt + DIM_BSDF_UV + 200)
     bs = bxdf.bsdf_sample(mp, wo_l, ul, ub1, ub2)
     wi_w = to_world(bs.wi, it.ss, it.ts, it.ns)
     f_b = bs.f * jnp.abs(dot(wi_w, it.ns))[..., None]
@@ -326,7 +392,9 @@ def estimate_direct(
             else None
         )
         le_env = ld.env_lookup(dev, wi_w)
-        lpdf_env = ld.infinite_pdf(dev, None if light_idx is not None else light_distr, wi_w)
+        lpdf_env = ld.infinite_pdf(
+            dev, None if light_idx is not None else light_distr, wi_w, ref_p=it.p
+        )
         if light_idx is not None:
             lpdf_env = lpdf_env * dev["light"]["type"].shape[0]
         miss = hit_b.prim < 0
@@ -355,13 +423,36 @@ class WavefrontIntegrator:
         self.scene = scene
         self.options = options
         strategy = scene.light_distribution_name
-        # "uniform" -> None; "power"/"spatial" -> power distribution (the
-        # voxel-hashed SpatialLightDistribution falls back to power here)
-        self.light_distr = None if strategy == "uniform" else scene.light_distr
+        # "uniform" -> None; "power" -> Distribution1D; "spatial" -> the
+        # dense per-voxel SpatialLightDistribution (multi-light scenes;
+        # single-light scenes gain nothing and keep power)
+        if strategy == "uniform":
+            self.light_distr = None
+        elif strategy == "spatial" and getattr(scene, "spatial_distr", None) is not None:
+            self.light_distr = scene.spatial_distr
+        else:
+            self.light_distr = scene.light_distr
         # shadow rays must pass through MAT_NONE container geometry (pbrt
         # VisibilityTester); pay the multi-segment walk only when the scene
         # actually has null interfaces
         self.vis_segments = 4 if scene.has_null_materials else 1
+        # compiled texture evaluator (None when everything constant-folded)
+        self.tex_eval = getattr(scene, "tex_eval", None)
+        self.tex_used = getattr(scene, "tex_used", frozenset())
+        # sampler plugin dispatch (VERDICT r3 #7): the scene file's
+        # Sampler directive selects the per-dimension stream structure
+        self.skind = normalize_sampler_name(scene.sampler.name)
+        self.spp = int(scene.sampler.spp)
+
+    def u1d(self, px, py, s, salt):
+        return sample_1d(self.skind, self.spp, px, py, s, salt)
+
+    def u2d(self, px, py, s, salt):
+        return sample_2d(self.skind, self.spp, px, py, s, salt)
+
+    def mat_at(self, dev, it) -> "bxdf.MatParams":
+        """Textured material parameters at a surface interaction."""
+        return textured_mat(dev, it.mat, it.uv, it.p, self.tex_eval, self.tex_used)
 
     # -- subclass hook ----------------------------------------------------
     def li(self, dev, o, d, px, py, s):
@@ -448,8 +539,7 @@ class WavefrontIntegrator:
             fx, fy = sobol_2d(s, sx_scr, sy_scr)
             p_film = jnp.stack([px.astype(jnp.float32) + fx, py.astype(jnp.float32) + fy], axis=-1)
             u_lens = jnp.stack(
-                [uniform_float(px, py, s, DIM_LENS), uniform_float(px, py, s, DIM_LENS + 1)],
-                axis=-1,
+                list(self.u2d(px, py, s, DIM_LENS)), axis=-1
             )
             o, d, wt = generate_rays(cam, p_film, u_lens)
             out = self.li(dev, o, d, px, py, s)
@@ -545,21 +635,63 @@ class WavefrontIntegrator:
         ray_counts = []
         chunks_done = first_chunk
         t0 = time.time()
+        c = first_chunk
+        attempt = 0
         with STATS.phase("Integrator/Render loop"):
-            for c in range(first_chunk, n_chunks):
+            while c < n_chunks:
                 st = starts[c]
-                if mesh is None:
-                    state, nrays = jfn(state, dev, st[0], st[1])
-                else:
-                    state, nrays = jfn(state, dev, st)
+                try:
+                    # failure seam (SURVEY.md §2e worker-failure row): a
+                    # dispatch that dies is re-run — chunks are idempotent
+                    # pure functions of the work range, so re-dispatch is
+                    # exact. If the failure could have poisoned the
+                    # accumulated film (a mid-flight device loss), the
+                    # checkpoint (if enabled) rolls the loop back to the
+                    # last durable state instead. `_fault_hook` lets tests
+                    # inject failures deterministically.
+                    hook = getattr(self, "_fault_hook", None)
+                    if hook is not None:
+                        hook(c, attempt)
+                    try:
+                        if mesh is None:
+                            state, nrays = jfn(state, dev, st[0], st[1])
+                        else:
+                            state, nrays = jfn(state, dev, st)
+                    except jax.errors.JaxRuntimeError as e:
+                        # real device/runtime loss mid-dispatch: the donated
+                        # film accumulator can no longer be trusted — route
+                        # through the poisoning recovery (checkpoint
+                        # rollback or restart), never reuse `state`
+                        raise ChunkDispatchError(
+                            f"device dispatch failed: {e}", poisons_state=True
+                        ) from e
+                except ChunkDispatchError as e:
+                    attempt += 1
+                    STATS.counter("Distribution/Chunks re-dispatched", 1)
+                    if attempt > 8:
+                        raise RuntimeError(
+                            f"chunk {c} failed {attempt} times"
+                        ) from e
+                    if e.poisons_state and ckpt_path and _os.path.exists(ckpt_path):
+                        state, c, prev_rays = load_checkpoint(ckpt_path, fp)
+                        ray_counts.clear()
+                    elif e.poisons_state:
+                        # no durable state to roll back to: restart the render
+                        state = film.init_state()
+                        c = 0
+                        prev_rays = 0
+                        ray_counts.clear()
+                    continue
+                attempt = 0
+                c += 1
                 ray_counts.append(nrays)  # defer the sync: keep the pipe full
                 progress.update()
-                chunks_done = c + 1
-                if ckpt_path and checkpoint_every and (c + 1) % checkpoint_every == 0:
+                chunks_done = c
+                if ckpt_path and checkpoint_every and c % checkpoint_every == 0:
                     save_checkpoint(
                         ckpt_path,
                         state,
-                        c + 1,
+                        c,
                         prev_rays + sum(int(r) for r in ray_counts),
                         fingerprint=fp,
                     )

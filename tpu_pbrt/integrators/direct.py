@@ -70,7 +70,7 @@ class DirectLightingIntegrator(WavefrontIntegrator):
             le = ld.emitted_radiance(dev, jnp.where(it.valid, it.light, -1), it.wo, it.ng)
             L = L + beta * le
 
-            mp = bxdf.gather_mat(dev["mat"], it.mat)
+            mp = self.mat_at(dev, it)
             if self.strategy == "all":
                 for li_i in range(self.n_light_loop):
                     idx = jnp.full(o.shape[:-1], li_i, jnp.int32)
@@ -78,6 +78,7 @@ class DirectLightingIntegrator(WavefrontIntegrator):
                         dev, self.light_distr, it, mp, px, py, s,
                         depth, light_idx=idx, salt_extra=li_i * 1000,
                         vis_segments=self.vis_segments,
+                        sampler=(self.skind, self.spp),
                     )
                     L = L + jnp.where(it.valid[..., None], beta * Ld, 0.0)
                     nrays = nrays + 2 * it.valid.astype(jnp.int32)
@@ -85,6 +86,7 @@ class DirectLightingIntegrator(WavefrontIntegrator):
                 Ld = estimate_direct(
                     dev, self.light_distr, it, mp, px, py, s, depth,
                     vis_segments=self.vis_segments,
+                    sampler=(self.skind, self.spp),
                 )
                 L = L + jnp.where(it.valid[..., None], beta * Ld, 0.0)
                 nrays = nrays + 2 * it.valid.astype(jnp.int32)
@@ -97,9 +99,8 @@ class DirectLightingIntegrator(WavefrontIntegrator):
             from tpu_pbrt.core.vecmath import to_local
 
             wo_l = to_local(it.wo, it.ss, it.ts, it.ns)
-            ul = uniform_float(px, py, s, salt + DIM_BSDF_LOBE + 77)
-            u1 = uniform_float(px, py, s, salt + DIM_BSDF_UV + 77)
-            u2 = uniform_float(px, py, s, salt + DIM_BSDF_UV + 177)
+            ul = self.u1d(px, py, s, salt + DIM_BSDF_LOBE + 77)
+            u1, u2 = self.u2d(px, py, s, salt + DIM_BSDF_UV + 77)
             bs = bxdf.bsdf_sample(mp, wo_l, ul, u1, u2)
             cont = it.valid & bs.is_specular & (bs.pdf > 0.0)
             wi_w = normalize(to_world(bs.wi, it.ss, it.ts, it.ns))

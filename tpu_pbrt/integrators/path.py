@@ -134,7 +134,7 @@ class PathIntegrator(WavefrontIntegrator):
             # ---- emitted radiance with forward MIS ----------------------
             if "envmap" in dev:
                 le_env = ld.env_lookup(dev, d)
-                pdf_env = ld.infinite_pdf(dev, self.light_distr, d)
+                pdf_env = ld.infinite_pdf(dev, self.light_distr, d, ref_p=prev_p)
                 w_env = jnp.where(
                     specular, 1.0, power_heuristic(1.0, prev_pdf, 1.0, pdf_env)
                 )
@@ -151,11 +151,10 @@ class PathIntegrator(WavefrontIntegrator):
             can_scatter = depth < self.max_depth
 
             # ---- NEE: light-sampling half only --------------------------
-            mp = bxdf.gather_mat(dev["mat"], it.mat)
+            mp = self.mat_at(dev, it)
             is_null = it.valid & (mp.mtype == MAT_NONE) if self.margin else None
-            u_pick = uniform_float(px, py, s, salt + DIM_LIGHT_PICK)
-            u1 = uniform_float(px, py, s, salt + DIM_LIGHT_UV)
-            u2 = uniform_float(px, py, s, salt + DIM_LIGHT_UV + 100)
+            u_pick = self.u1d(px, py, s, salt + DIM_LIGHT_PICK)
+            u1, u2 = self.u2d(px, py, s, salt + DIM_LIGHT_UV)
             ls = ld.sample_one_light(dev, self.light_distr, it.p, u_pick, u1, u2)
             wo_l = to_local(it.wo, it.ss, it.ts, it.ns)
             wi_l = to_local(ls.wi, it.ss, it.ts, it.ns)
@@ -188,9 +187,8 @@ class PathIntegrator(WavefrontIntegrator):
                 L = L + jnp.where((do_nee & visible)[..., None], beta * Ld, 0.0)
 
             # ---- continuation: BSDF sample ------------------------------
-            ul = uniform_float(px, py, s, salt + DIM_BSDF_LOBE)
-            ub1 = uniform_float(px, py, s, salt + DIM_BSDF_UV)
-            ub2 = uniform_float(px, py, s, salt + DIM_BSDF_UV + 100)
+            ul = self.u1d(px, py, s, salt + DIM_BSDF_LOBE)
+            ub1, ub2 = self.u2d(px, py, s, salt + DIM_BSDF_UV)
             bs = bxdf.bsdf_sample(mp, wo_l, ul, ub1, ub2)
             wi_w = normalize(to_world(bs.wi, it.ss, it.ts, it.ns))
             cont = it.valid & can_scatter & (bs.pdf > 0.0) & (jnp.max(bs.f, axis=-1) > 0.0)

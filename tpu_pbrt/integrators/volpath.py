@@ -90,7 +90,7 @@ class VolPathIntegrator(WavefrontIntegrator):
             # ---- emitted radiance (surface / env) with forward MIS ------
             if "envmap" in dev:
                 le_env = ld.env_lookup(dev, d)
-                pdf_env = ld.infinite_pdf(dev, self.light_distr, d)
+                pdf_env = ld.infinite_pdf(dev, self.light_distr, d, ref_p=prev_p)
                 w_env = jnp.where(specular, 1.0, power_heuristic(1.0, prev_pdf, 1.0, pdf_env))
                 L = L + jnp.where(escaped[..., None], beta * le_env * w_env[..., None], 0.0)
             hit_light = jnp.where(at_surface, it.light, -1)
@@ -104,7 +104,7 @@ class VolPathIntegrator(WavefrontIntegrator):
                 break
 
             # ---- null material passthrough (medium transition) ----------
-            mp = bxdf.gather_mat(dev["mat"], it.mat)
+            mp = self.mat_at(dev, it)
             is_null = at_surface & (mp.mtype == MAT_NONE)
             going_in_null = dot(d, it.ng) < 0.0
             med_in = dev["tri_med_in"][jnp.maximum(hit.prim, 0)]
@@ -115,9 +115,8 @@ class VolPathIntegrator(WavefrontIntegrator):
             # ---- NEE ----------------------------------------------------
             p_medium = o + ms.t[..., None] * d
             ref_p = jnp.where(in_medium[..., None], p_medium, it.p)
-            u_pick = uniform_float(px, py, s, salt + DIM_LIGHT_PICK)
-            u1 = uniform_float(px, py, s, salt + DIM_LIGHT_UV)
-            u2 = uniform_float(px, py, s, salt + DIM_LIGHT_UV + 100)
+            u_pick = self.u1d(px, py, s, salt + DIM_LIGHT_PICK)
+            u1, u2 = self.u2d(px, py, s, salt + DIM_LIGHT_UV)
             ls = ld.sample_one_light(dev, self.light_distr, ref_p, u_pick, u1, u2)
             # scatter function value and pdf toward the light
             wo_l = to_local(it.wo, it.ss, it.ts, it.ns)
@@ -163,9 +162,8 @@ class VolPathIntegrator(WavefrontIntegrator):
             wi_m = normalize(wi_m)
 
             # surface: BSDF sample
-            ul = uniform_float(px, py, s, salt + DIM_BSDF_LOBE)
-            ub1 = uniform_float(px, py, s, salt + DIM_BSDF_UV)
-            ub2 = uniform_float(px, py, s, salt + DIM_BSDF_UV + 100)
+            ul = self.u1d(px, py, s, salt + DIM_BSDF_LOBE)
+            ub1, ub2 = self.u2d(px, py, s, salt + DIM_BSDF_UV)
             bs = bxdf.bsdf_sample(mp, wo_l, ul, ub1, ub2)
             wi_surf = normalize(to_world(bs.wi, it.ss, it.ts, it.ns))
             cont_surf = at_surface & (bs.pdf > 0.0) & (jnp.max(bs.f, axis=-1) > 0.0)

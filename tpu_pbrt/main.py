@@ -37,6 +37,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--spp-chunk", type=int, default=0, help="samples per render chunk (0 = auto)")
     p.add_argument("--checkpoint", default="", help="checkpoint file: resume from it if present, write to it while rendering")
     p.add_argument("--checkpoint-every", type=int, default=16, help="chunks between checkpoint writes")
+    p.add_argument(
+        "--multihost",
+        action="store_true",
+        help="initialize jax.distributed (multi-host pod rendering over DCN; "
+        "also auto-enabled by JAX_COORDINATOR_ADDRESS)",
+    )
     return p
 
 
@@ -53,7 +59,11 @@ def main(argv=None) -> int:
         spp_chunk=args.spp_chunk,
         checkpoint_path=args.checkpoint,
         checkpoint_every=args.checkpoint_every,
+        multihost=args.multihost,
     )
+    from tpu_pbrt.parallel.mesh import maybe_init_distributed
+
+    maybe_init_distributed(opts)
     for scene in args.scenes:
         try:
             render_file(scene, opts)

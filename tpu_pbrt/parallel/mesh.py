@@ -35,6 +35,34 @@ except ImportError:  # pragma: no cover
 TILE_AXIS = "tiles"
 
 
+def maybe_init_distributed(options=None) -> bool:
+    """Multi-host seam: bring up the JAX distributed runtime (DCN
+    coordination; the multi-host analog of the fork's master/worker
+    socket channel). Activates when the standard cluster-environment
+    variables are present (JAX_COORDINATOR_ADDRESS / auto-detected TPU
+    pod env) or options.multihost is set. Idempotent; returns whether the
+    distributed runtime is live. After this, jax.devices() spans all
+    hosts and the same shard_map program runs pod-wide."""
+    import os
+
+    want = bool(getattr(options, "multihost", False)) or bool(
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+    )
+    if not want:
+        return False
+    try:
+        jax.distributed.initialize()
+        return True
+    except (RuntimeError, ValueError) as e:
+        # already initialized counts as success
+        if "already" in str(e).lower():
+            return True
+        from tpu_pbrt.utils.error import Warning as _W
+
+        _W(f"jax.distributed.initialize failed: {e}; running single-host")
+        return False
+
+
 def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     """1-D device mesh over the tile axis (a renderer's parallel axis is
     image/sample space — SURVEY.md §2f maps it to data-parallel)."""

@@ -176,6 +176,7 @@ class Options:
     spp_chunk: int = 0  # TPU-specific: samples per chunk (0 = auto)
     checkpoint_path: str = ""  # TPU-specific: film checkpoint for resume
     checkpoint_every: int = 0  # chunks between checkpoint writes (0 = off)
+    multihost: bool = False  # bring up jax.distributed (multi-host DCN)
 
 
 class PbrtAPI:
