@@ -310,7 +310,7 @@ def test_stream_matches_oracle():
     from tpu_pbrt.accel.treelet import build_treelet_pack
 
     rng = np.random.default_rng(31)
-    tris = random_tris(3000, rng)
+    tris = random_tris(9000, rng)  # > 8 treelets at the 512-tri leaf default
     bvh = bvh_build.build_bvh(*bvh_build.triangle_bounds(tris), method="sah")
     tris_perm = tris[bvh.prim_order]
     tp = build_treelet_pack(tris_perm, bvh, leaf_tris=STREAM_LEAF_TRIS)
@@ -368,10 +368,12 @@ def test_pallas_leaf_kernel_parity_interpret():
     d = rng.normal(size=(B, 128, 3)).astype(np.float32)
     d /= np.linalg.norm(d, axis=-1, keepdims=True)
     tb = jnp.full((B, 128), 1e30, jnp.float32)
-    phi = ray_features(jnp.asarray(o), jnp.asarray(d))
-    feat_b = jnp.asarray(featT)
+    # the kernel contract is TRANSPOSED (features on the contraction dim,
+    # rays on lanes): phi (B, 16, 128), feat (B, 16, 4L)
+    phi = jnp.swapaxes(ray_features(jnp.asarray(o), jnp.asarray(d)), 1, 2)
+    feat_b = jnp.swapaxes(jnp.asarray(featT), 1, 2)
 
-    out = jnp.einsum("cbf,ckf->cbk", phi, feat_b, precision=jax.lax.Precision.HIGHEST)
+    out = jnp.einsum("cfb,cfk->cbk", phi, feat_b, precision=jax.lax.Precision.HIGHEST)
     t_ref, k_ref, _, _ = decode_outputs(out, L, tb)
 
     real_call = pl.pallas_call

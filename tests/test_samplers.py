@@ -67,9 +67,13 @@ def _prod_rms(kind, spp, n_pix=256, dim=5):
 def test_ld_beats_random_2d():
     spp = 16
     r = _prod_rms("random", spp)
-    for kind in ("02", "halton"):
+    # (0,2) is base-2 through and through: near-perfect at power-of-two
+    # spp. Halton's odd-prime pairs only fully stratify at b^k samples,
+    # so its margin at spp=16 is real but smaller (pbrt's Halton has the
+    # same property).
+    for kind, bound in (("02", 0.6), ("halton", 0.8)):
         ld = _prod_rms(kind, spp)
-        assert ld < 0.6 * r, f"{kind}: rms {ld} not < 0.6x random {r}"
+        assert ld < bound * r, f"{kind}: rms {ld} not < {bound}x random {r}"
 
 
 def test_dimension_decorrelation():

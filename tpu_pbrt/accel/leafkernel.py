@@ -36,11 +36,11 @@ from tpu_pbrt.accel.mxu import EDGE_EPS
 
 
 def _leaf_kernel(feat_ref, phi_ref, tb_ref, t_out_ref, k_out_ref, *, L: int):
-    featT = feat_ref[0]  # (4L, 16)
+    featT = feat_ref[0]  # (16, 4L): features on the contraction dim
     phiT = phi_ref[0]  # (16, 128)
     out4 = jax.lax.dot_general(
         featT, phiT,
-        dimension_numbers=(((1,), (0,)), ((), ())),
+        dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
     )  # (4L, 128)
@@ -83,15 +83,15 @@ def leaf_blocks_intersect_prefetch(feat_table, tids, phi, t_b):
     same treelet row was re-fetched for every one of its ~dozens of
     blocks AND round-tripped through a (B, 4L, 16) HBM temporary)."""
     B = tids.shape[0]
-    _, fourL, _ = feat_table.shape
+    _, _, fourL = feat_table.shape  # (C, 16, 4L)
     L = fourL // 4
-    phiT = jnp.swapaxes(phi, 1, 2)  # (B, 16, 128)
+    phiT = phi  # caller builds (B, 16, 128) directly
     tb2 = t_b[:, None, :]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, fourL, 16), lambda i, tids_ref: (tids_ref[i], 0, 0)),
+            pl.BlockSpec((1, 16, fourL), lambda i, tids_ref: (tids_ref[i], 0, 0)),
             pl.BlockSpec((1, 16, 128), lambda i, tids_ref: (i, 0, 0)),
             pl.BlockSpec((1, 1, 128), lambda i, tids_ref: (i, 0, 0)),
         ],
@@ -113,20 +113,20 @@ def leaf_blocks_intersect_prefetch(feat_table, tids, phi, t_b):
 
 @partial(jax.jit, static_argnames=())
 def leaf_blocks_intersect(feat_b, phi, t_b):
-    """feat_b: (B, 4L, 16) gathered treelet features; phi: (B, 128, 16)
-    ray features (re-centered); t_b: (B, 128) per-slot current t_max.
+    """feat_b: (B, 16, 4L) gathered TRANSPOSED treelet features; phi:
+    (B, 16, 128) transposed ray features (re-centered); t_b: (B, 128).
     Returns (t_loc, k_loc): (B, 128) closest-hit distance (inf = miss,
     always < t_b on hit) and LOCAL triangle index within the treelet —
     the same contract as mxu.decode_outputs' first two outputs."""
-    B, fourL, _ = feat_b.shape
+    B, _, fourL = feat_b.shape  # (B, 16, 4L)
     L = fourL // 4
-    phiT = jnp.swapaxes(phi, 1, 2)  # (B, 16, 128): rays on the lane dim
+    phiT = phi  # caller builds (B, 16, 128) directly (rays on lanes)
     tb2 = t_b[:, None, :]  # (B, 1, 128)
     t_loc, k_loc = pl.pallas_call(
         partial(_leaf_kernel, L=L),
         grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, fourL, 16), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 16, fourL), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 16, 128), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, 128), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
         ],

@@ -56,7 +56,7 @@ fewer pairs), never the execution shape — the design goal for a wavefront
 path tracer whose bounce waves are inherently incoherent.
 
 The acceleration structure is the same two-level TreeletPack as the packet
-walk (accel/treelet.py) with fatter leaves (STREAM_LEAF_TRIS = 256): the
+walk (accel/treelet.py) with fatter leaves (STREAM_LEAF_TRIS): the
 MXU makes triangle tests nearly free, so trading deeper trees for fatter
 matmuls moves work from the latency-bound worklist to the compute units.
 """
@@ -73,10 +73,13 @@ import numpy as np
 from tpu_pbrt.accel.mxu import decode_outputs, ray_features
 from tpu_pbrt.accel.traverse import Hit
 from tpu_pbrt.accel.treelet import TreeletPack, decode_top_leaf
-from tpu_pbrt.accel.wide import _EMPTY, slab_test
+from tpu_pbrt.accel.wide import _EMPTY, slab_test_lane_major
 
-#: triangles per treelet for the stream path (feature row = 4*this columns)
-STREAM_LEAF_TRIS = 256
+#: triangles per treelet for the stream path (feature row = 4*this
+#: columns). Swept on the v5e bench: 256 -> 0.61 Mray/s, 512 -> 0.73
+#: (fewer worklist pairs; the fatter matmul is nearly free on the MXU),
+#: 1024 -> 0.36 (matmul cost finally dominates).
+STREAM_LEAF_TRIS = 512
 #: rays per leaf block — the MXU matmul's row dimension
 BLOCK = 128
 #: leaf blocks processed per flush chunk (bounds transient memory: the
@@ -149,8 +152,8 @@ def _unbits(x):
     return jax.lax.bitcast_convert_type(x, jnp.float32)
 
 
-def _expand(tp: TreeletPack, boxes, o_inv, s: _SState, slab: int, w: int,
-            lb: int, any_hit: bool):
+def _expand(tp: TreeletPack, boxT, cidT, o_invT, s: _SState, slab: int,
+            w: int, lb: int, any_hit: bool):
     start = jnp.maximum(s.n_stk - slab, 0)
     k = jnp.arange(slab, dtype=jnp.int32)
     valid = k < (s.n_stk - start)
@@ -164,18 +167,25 @@ def _expand(tp: TreeletPack, boxes, o_inv, s: _SState, slab: int, w: int,
     if any_hit:
         live = live & (s.prim[rid] < 0)
 
-    # NOTE: child ids must NOT ride the float box row as bitcast floats —
-    # negative int32 codes alias NaN bit patterns and TPU XLA canonicalizes
-    # NaN payloads (CPU preserves them), silently corrupting the codes
-    nbox = boxes[node]  # (S, 8, 6): one packed row per pair
-    nmin = nbox[..., :3]
-    nmax = nbox[..., 3:6]
-    cids = tp.top.child_idx[node]  # (S, 8)
-    ray6 = o_inv[rid]  # (S, 6): origin | 1/d
-    o_r = ray6[:, None, :3]
-    inv_r = ray6[:, None, 3:]
-    tn8, _, in_slab = slab_test(nmin, nmax, o_r, inv_r, t_r[:, None])  # (S,8)
-    hit8 = live[:, None] & in_slab & (cids != _EMPTY)
+    # ---- lane-major slab tests ------------------------------------------
+    # Layout is everything here (profiled): (S, 8, 3)-shaped math puts 3
+    # on the TPU lane dimension (3/128 utilization) and its axis reductions
+    # + tiny-row gathers were ~38% of the wave. All arrays below keep the
+    # SLAB dimension minor: tables are pre-transposed to (6, 8, N)/(8, N)/
+    # (6, R) and gathered along their LAST axis, so every elementwise op
+    # and min/max chain runs on (8, S) with full lanes and no reductions.
+    nb = jnp.take(boxT, node, axis=2)  # (6, 8, S)
+    cids = jnp.take(cidT, node, axis=1)  # (8, S)
+    ray6 = jnp.take(o_invT, rid, axis=1)  # (6, S)
+
+    tx0, tx1 = slab_test_lane_major(nb[0], nb[3], ray6[0][None, :], ray6[3][None, :])
+    ty0, ty1 = slab_test_lane_major(nb[1], nb[4], ray6[1][None, :], ray6[4][None, :])
+    tz0, tz1 = slab_test_lane_major(nb[2], nb[5], ray6[2][None, :], ray6[5][None, :])
+    tn8 = jnp.maximum(jnp.maximum(tx0, ty0), jnp.maximum(tz0, 0.0))  # (8,S)
+    tf8 = jnp.minimum(jnp.minimum(tx1, ty1), jnp.minimum(tz1, t_r[None, :]))
+    in_slab = tn8 <= tf8
+
+    hit8 = live[None, :] & in_slab & (cids != _EMPTY)
     is_int = hit8 & (cids >= 0)
     is_leaf = hit8 & (cids < 0)
 
@@ -186,7 +196,7 @@ def _expand(tp: TreeletPack, boxes, o_inv, s: _SState, slab: int, w: int,
         is_leaf, -jnp.inf, jnp.where(is_int, -tn8, jnp.inf)
     ).reshape(-1)
     cand_code = jnp.where(is_leaf, decode_top_leaf(cids), cids).reshape(-1)
-    cand_ray = jnp.broadcast_to(rid[:, None], cids.shape).reshape(-1)
+    cand_ray = jnp.broadcast_to(rid[None, :], cids.shape).reshape(-1)
     cand_tn = _bits(tn8).reshape(-1)
     _, code_s, ray_s, tn_s = jax.lax.sort(
         [key, cand_code, cand_ray, cand_tn], num_keys=1
@@ -234,7 +244,8 @@ def _expand(tp: TreeletPack, boxes, o_inv, s: _SState, slab: int, w: int,
     )
 
 
-def _flush(tp: TreeletPack, o, d, s: _SState, lb: int, any_hit: bool):
+def _flush(tp: TreeletPack, featT_tab, oT, dT, s: _SState, lb: int,
+           any_hit: bool):
     R = s.t.shape[0]
     C = tp.n_treelets
     L = tp.leaf_tris
@@ -292,28 +303,35 @@ def _flush(tp: TreeletPack, o, d, s: _SState, lb: int, any_hit: bool):
         tids = jnp.clip(tids, 0, C - 1)
         has_ray = rows >= 0
         rid = jnp.where(has_ray, rows, 0)
-        o_b = o[rid]  # (CH, BLOCK, 3)
-        d_b = d[rid]
         t_b = jnp.where(has_ray, t[rid], -jnp.inf)  # dead slots: t<tm fails
         ctr = tp.center[tids]  # (CH, 3)
         off = tp.offset[tids]  # (CH,)
-        phi = ray_features(o_b - ctr[:, None, :], d_b)
+        # component-wise ray fetch + TRANSPOSED feature build: phi rows on
+        # axis 1, the 128 rays on lanes — (CH, BLOCK, 16) would put 16 on
+        # lanes (the profiled layout sin of the old path)
+        oc = [jnp.take(oT[i], rid) - ctr[:, i][:, None] for i in range(3)]
+        dc = [jnp.take(dT[i], rid) for i in range(3)]
+        phiT = jnp.stack(
+            [oc[i] * dc[j] for i in range(3) for j in range(3)]
+            + dc + oc + [jnp.ones_like(oc[0])],
+            axis=1,
+        )  # (CH, 16, BLOCK)
         if use_prefetch:
             # full feature table stays in HBM; the kernel's scalar-prefetch
             # index_map DMAs each block's treelet row directly (no
-            # materialized (CH, 4L, 16) gather)
+            # materialized (CH, 16, 4L) gather)
             from tpu_pbrt.accel.leafkernel import leaf_blocks_intersect_prefetch
 
-            t_loc, k_loc = leaf_blocks_intersect_prefetch(tp.feat, tids, phi, t_b)
+            t_loc, k_loc = leaf_blocks_intersect_prefetch(featT_tab, tids, phiT, t_b)
         elif use_pallas:
             from tpu_pbrt.accel.leafkernel import leaf_blocks_intersect
 
-            feat = tp.feat[tids]  # (CH, 4L, 16)
-            t_loc, k_loc = leaf_blocks_intersect(feat, phi, t_b)
+            featT = featT_tab[tids]  # (CH, 16, 4L)
+            t_loc, k_loc = leaf_blocks_intersect(featT, phiT, t_b)
         else:
-            feat = tp.feat[tids]  # (CH, 4L, 16)
+            featT = featT_tab[tids]  # (CH, 16, 4L)
             out = jnp.einsum(
-                "cbf,ckf->cbk", phi, feat,
+                "cfb,cfk->cbk", phiT, featT,
                 precision=jax.lax.Precision.HIGHEST,
             )
             t_loc, k_loc, _, _ = decode_outputs(out, L, t_b)
@@ -346,10 +364,18 @@ def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool) -> _SState:
     slab, w, lb = _sizes(R)
     s8 = 8 * slab
     inv_d = 1.0 / d
-    o_inv = jnp.concatenate([o, inv_d], axis=-1)  # (R, 6): one gather row
-    boxes = jnp.concatenate(
-        [tp.top.child_bmin, tp.top.child_bmax], axis=-1
-    )  # (N, 8, 6): one gathered row per pair
+    # lane-major tables, transposed ONCE per wave (see _expand's layout
+    # note): gathers index the LAST axis so their outputs keep the big
+    # dimension on TPU lanes
+    o_invT = jnp.concatenate([o, inv_d], axis=-1).T  # (6, R)
+    boxT = jnp.transpose(
+        jnp.concatenate([tp.top.child_bmin, tp.top.child_bmax], axis=-1),
+        (2, 1, 0),
+    )  # (6, 8, N)
+    cidT = tp.top.child_idx.T  # (8, N)
+    featT_tab = tp.featT  # (C, 16, 4L), stored at build
+    oT = o.T  # (3, R)
+    dT = d.T
 
     rid0 = jnp.arange(R, dtype=jnp.int32)
     tn0 = _bits(jnp.where(t_max > 0.0, 0.0, jnp.inf).astype(jnp.float32))
@@ -383,8 +409,8 @@ def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool) -> _SState:
         do_flush = (s.n_lf > lb - s8) | (s.n_stk == 0)
         return jax.lax.cond(
             do_flush,
-            lambda ss: _flush(tp, o, d, ss, lb, any_hit),
-            lambda ss: _expand(tp, boxes, o_inv, ss, slab, w, lb, any_hit),
+            lambda ss: _flush(tp, featT_tab, oT, dT, ss, lb, any_hit),
+            lambda ss: _expand(tp, boxT, cidT, o_invT, ss, slab, w, lb, any_hit),
             s,
         )
 

@@ -48,6 +48,9 @@ class TreeletPack(NamedTuple):
 
     top: WideBVH  # 8-wide top tree; leaf codes encode treelet ids
     feat: jnp.ndarray  # (C, 4*LEAF_TRIS, 16) f32 MT feature matrices
+    featT: jnp.ndarray  # (C, 16, 4*LEAF_TRIS): the stream tracer's layout
+    # (stored at build — transposing per wave would copy the scene's
+    # largest array, ~1 GB for crown-class, every traversal call)
     center: jnp.ndarray  # (C, 3) f32 re-centering point per treelet
     offset: jnp.ndarray  # (C,) i32 first leaf-order triangle id
     count: jnp.ndarray  # (C,) i32 triangles in treelet
@@ -162,6 +165,7 @@ def build_treelet_pack(
     return TreeletPack(
         top=top,
         feat=jnp.asarray(feat),
+        featT=jnp.asarray(np.ascontiguousarray(feat.transpose(0, 2, 1))),
         center=jnp.asarray(center),
         offset=jnp.asarray(off, jnp.int32),
         count=jnp.asarray(cnt, jnp.int32),

@@ -65,6 +65,22 @@ def slab_test(nmin, nmax, o, inv_d, t_far):
     return tn, tf, tn <= tf
 
 
+def slab_test_lane_major(b_lo, b_hi, o_c, inv_c):
+    """Per-AXIS half of slab_test for lane-major layouts (the stream
+    walker's (8, S) arrays): returns this axis's (t0, t1) with the SAME
+    _BOX_EPS widening and NaN rules as slab_test above — one source for
+    the watertightness semantics, two layouts. Callers combine the three
+    axes with explicit min/max chains (no axis reductions) and clamp
+    t_near to 0 / t_far to the ray's current hit themselves."""
+    lo = jnp.where(inv_c < 0, b_hi, b_lo)
+    hi = jnp.where(inv_c < 0, b_lo, b_hi)
+    t0 = (lo - o_c) * inv_c
+    t1 = (hi - o_c) * inv_c * _BOX_EPS
+    t0 = jnp.where(jnp.isnan(t0), -jnp.inf, t0)
+    t1 = jnp.where(jnp.isnan(t1), jnp.inf, t1)
+    return t0, t1
+
+
 class WideBVH(NamedTuple):
     child_bmin: jnp.ndarray  # (N, 8, 3)
     child_bmax: jnp.ndarray  # (N, 8, 3)

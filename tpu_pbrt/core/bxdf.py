@@ -322,8 +322,13 @@ def _lobe_flags(mp: MatParams):
     )
     glossy = (
         (t == MAT_PLASTIC) | (t == MAT_METAL) | (t == MAT_UBER) | (t == MAT_SUBSTRATE) | (t == MAT_DISNEY)
+        # rough glass is a real (non-delta) microfacet BSDF: SPPM stores
+        # visible points on glossy surfaces at the depth cap, and
+        # bsdf_eval/bsdf_sample override rg lanes wholesale, so flagging
+        # it glossy here cannot double-count lobes
+        | _is_rough_glass(mp)
     )
-    specular = (t == MAT_GLASS) | (t == MAT_MIRROR)
+    specular = ((t == MAT_GLASS) & ~_is_rough_glass(mp)) | (t == MAT_MIRROR)
     return diffuse, glossy, specular
 
 

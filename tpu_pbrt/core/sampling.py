@@ -318,7 +318,11 @@ def radical_inverse_prime(base: int, n, scramble_seed=None):
 #   (lowdiscrepancy.cpp: equivalent stratification, no 2^k image tiling).
 # -------------------------------------------------------------------------
 
-_HALTON_PAIRS = [(2, 3), (5, 7), (11, 13), (17, 19), (23, 29), (31, 37)]
+#: joint 2D bases for halton pair-dimensions — LOW primes only (base-b
+#: stratification is only perfect at b^k samples, so large bases stratify
+#: poorly at render spp; pair reuse is decorrelated by the per-dimension
+#: sample-order shuffle)
+_HALTON_PAIRS = [(2, 3), (5, 7), (3, 5), (7, 2), (2, 5), (3, 7)]
 
 
 def sample_1d(kind: str, spp: int, px, py, s, salt):
@@ -328,24 +332,16 @@ def sample_1d(kind: str, spp: int, px, py, s, salt):
     if kind == "stratified":
         return stratified_1d(s, spp, px, py, salt)
     if kind == "halton":
-        # LOW prime bases (high bases stratify poorly at render spp);
-        # the per-dimension sample-order shuffle keeps each dimension's
-        # point set intact while decorrelating reused bases (the padded-
-        # sampler construction). salt may be TRACED (path.py's while_loop
-        # bounce counter): the base pick becomes a lax.switch then.
+        # 1D dimensions use the base-2 sequence with a per-dimension
+        # sample-order shuffle + XOR scramble: base 2 stratifies perfectly
+        # at the power-of-two spp renders use (a base-b sequence only
+        # stratifies at b^k samples, and a digit scramble turns a partial
+        # prefix into a random stratum subset), while the shuffle
+        # decorrelates dimensions (the padded-sampler construction).
+        # Halton's distinguishing JOINT low-discrepancy lives in the
+        # prime-base pairs of sample_2d.
         sp = permutation_element(s, spp, hash_u32(px, py, salt, 0x6E5))
-        seed = hash_u32(px, py, salt, 0x4A1)
-        if isinstance(salt, (int, np.integer)):
-            return radical_inverse_prime(PRIMES[salt % 4], sp, seed)
-        import jax as _jax
-
-        return _jax.lax.switch(
-            jnp.asarray(salt % 4, jnp.int32),
-            [
-                (lambda b: lambda: radical_inverse_prime(b, sp, seed))(b)
-                for b in (2, 3, 5, 7)
-            ],
-        )
+        return radical_inverse_base2(sp, hash_u32(px, py, salt, 0x4A1))
     # (0,2)-family: shuffled + scrambled van der Corput
     sp = permutation_element(s, spp, hash_u32(px, py, salt, 0x7F2))
     return radical_inverse_base2(sp, hash_u32(px, py, salt, 0x9D3))

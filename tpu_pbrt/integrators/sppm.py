@@ -23,11 +23,11 @@ TPU-first redesign of the two racy structures (SURVEY.md §5.2, §7 stage 8):
   so every device gathers its own visible points against the full photon
   set (parallel/mesh.py holds the mesh machinery).
 
-Capacity note: runs longer than `scan_cap` photons (per cell, per
-iteration) are truncated and counted in the `photons_dropped` stat —
-pbrt's linked lists are unbounded; our bound is the price of static
-shapes. The default cap is sized so target photon densities (photons ~
-pixels, cells ~ scene extent / 2r) never truncate; tests assert 0 drops.
+Capacity note: every cell run is scanned to EXHAUSTION — a while_loop
+walks each run in `scancap`-photon chunks, so nothing is ever dropped
+(pbrt's linked lists are unbounded and so, effectively, is this; the
+chunk size only trades loop iterations against per-chunk width). The
+`photons_dropped` stat is kept for API stability and is always 0.
 """
 
 from __future__ import annotations
@@ -103,7 +103,8 @@ class SPPMIntegrator(WavefrontIntegrator):
         self.n_iterations = params.find_one_int("numiterations", 64)
         self.photons_per_iter = params.find_one_int("photonsperiteration", -1)
         self.initial_radius = params.find_one_float("radius", 1.0)
-        #: photons scanned per overlapped cell (see capacity note above)
+        #: photons per gather chunk (see capacity note above — a width/
+        #: iterations tradeoff, not a truncation bound)
         self.scan_cap = params.find_one_int("scancap", 32)
         from tpu_pbrt.utils.error import Warning as _W
 
@@ -376,12 +377,9 @@ class SPPMIntegrator(WavefrontIntegrator):
         wo_l = to_local(vps.wo, vps.ss, vps.ts, vps.ns)
 
         # collect the 8 overlapped cells' run windows first (cheap index
-        # math), then ONE fused (P, 8K) distance-test + BSDF evaluation —
-        # unrolling bsdf_eval per cell would blow the program size 8x
-        # (compile-time dominated on CPU test runs)
-        slots = []
-        oks = []
-        dropped = jnp.zeros((), jnp.int32)
+        # math): starts/ends (P, 8)
+        starts = []
+        ends = []
         for ox in (0, 1):
             for oy in (0, 1):
                 for oz in (0, 1):
@@ -395,38 +393,59 @@ class SPPMIntegrator(WavefrontIntegrator):
                     cid = jnp.where(
                         use, c[..., 0] + gx * (c[..., 1] + gy * c[..., 2]), n_cells
                     )
-                    start = jnp.searchsorted(dcell_s, cid, side="left").astype(jnp.int32)
-                    end = jnp.searchsorted(dcell_s, cid, side="right").astype(jnp.int32)
+                    st = jnp.searchsorted(dcell_s, cid, side="left").astype(jnp.int32)
+                    en = jnp.searchsorted(dcell_s, cid, side="right").astype(jnp.int32)
                     # lanes with no VP / out-of-grid cell scan nothing (the
                     # n_cells sentinel's run is the invalid-deposit tail)
-                    end = jnp.where(use, end, start)
-                    dropped = dropped + jnp.sum(
-                        jnp.maximum(end - start, 0) - jnp.minimum(end - start, K)
-                    )
-                    slot = start[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]
-                    oks.append(slot < end[:, None])
-                    slots.append(jnp.minimum(slot, n_dep - 1))
-        slot = jnp.concatenate(slots, axis=1)  # (P, 8K)
-        ok = jnp.concatenate(oks, axis=1)
-        ppos = dp_s[slot]  # (P,8K,3)
-        diff = ppos - vps.p[:, None, :]
-        d2 = jnp.sum(diff * diff, axis=-1)
-        within = ok & (d2 <= r2[:, None])
-        wi_w = -dd_s[slot]  # (P,8K,3)
-        wi_l = to_local(
-            wi_w, vps.ss[:, None, :], vps.ts[:, None, :], vps.ns[:, None, :]
+                    starts.append(st)
+                    ends.append(jnp.where(use, en, st))
+        start8 = jnp.stack(starts, axis=1)  # (P, 8)
+        end8 = jnp.stack(ends, axis=1)
+
+        # scan each run in K-photon chunks inside ONE while_loop (a single
+        # bsdf_eval instantiation, like the fori-rolled passes): every run
+        # is scanned to EXHAUSTION — pbrt's unbounded linked lists drop
+        # nothing, and neither does this. The loop runs until the wave's
+        # longest remaining run is done; early iterations (radius spanning
+        # few coarse cells) simply take more chunks.
+        mp_b = jax.tree.map(
+            lambda a: a[:, None] if a.ndim == 1 else a[:, None, :], mp_vp
         )
-        f, _ = bxdf.bsdf_eval(
-            jax.tree.map(
-                lambda a: a[:, None] if a.ndim == 1 else a[:, None, :], mp_vp
-            ),
-            wo_l[:, None, :],
-            wi_l,
+        wo_b = wo_l[:, None, :]
+        koff = jnp.arange(K, dtype=jnp.int32)
+
+        def cond(carry):
+            j, phi, m = carry
+            return jnp.any(start8 + j * K < end8)
+
+        def body(carry):
+            j, phi, m = carry
+            # (P, 8, K) slots for this chunk of every cell's run
+            slot = start8[..., None] + j * K + koff[None, None, :]
+            ok = slot < end8[..., None]
+            slot = jnp.minimum(slot, n_dep - 1).reshape(P, 8 * K)
+            ok = ok.reshape(P, 8 * K)
+            ppos = dp_s[slot]  # (P,8K,3)
+            diff = ppos - vps.p[:, None, :]
+            d2 = jnp.sum(diff * diff, axis=-1)
+            within = ok & (d2 <= r2[:, None])
+            wi_w = -dd_s[slot]
+            wi_l = to_local(
+                wi_w, vps.ss[:, None, :], vps.ts[:, None, :], vps.ns[:, None, :]
+            )
+            f, _ = bxdf.bsdf_eval(mp_b, wo_b, wi_l)
+            contrib = jnp.where(within[..., None], f * db_s[slot], 0.0)
+            return (
+                j + 1,
+                phi + jnp.sum(contrib, axis=1),
+                m + jnp.sum(within, axis=1).astype(jnp.float32),
+            )
+
+        _, phi, m = jax.lax.while_loop(
+            cond, body,
+            (jnp.int32(0), jnp.zeros((P, 3), jnp.float32), jnp.zeros((P,), jnp.float32)),
         )
-        contrib = jnp.where(within[..., None], f * db_s[slot], 0.0)
-        phi = jnp.sum(contrib, axis=1)
-        m = jnp.sum(within, axis=1).astype(jnp.float32)
-        return phi, m, dropped
+        return phi, m, jnp.zeros((), jnp.int32)
 
     # ------------------------------------------------------------------
     def render(self, scene=None, mesh=None, max_seconds: float = 0.0, **kw) -> RenderResult:

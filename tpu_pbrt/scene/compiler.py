@@ -335,9 +335,79 @@ def _tess_loopsubdiv(params, scene_dir):
     return verts, normals, None
 
 
+def _tess_curve(params, scene_dir):
+    """shapes/curve.cpp capability: cubic Bezier hair/fur segments.
+
+    pbrt intersects the curve analytically by recursive subdivision; the
+    TPU-first mapping TESSELLATES each segment into a camera-independent
+    flat ribbon strip (the same geometric model pbrt's "flat" curves use —
+    ribbons that ignore orientation render identically under the width
+    interpolation; "cylinder" curves approximate to the same ribbon). uv:
+    u along the curve, v across the width."""
+    cps = params.find_point3("P")
+    if cps is None:
+        Error("curve requires control points P")
+        return None
+    cps = np.asarray(cps, np.float64).reshape(-1, 3)
+    if len(cps) < 4:
+        Error("curve requires at least 4 control points")
+        return None
+    w0 = params.find_one_float("width0", params.find_one_float("width", 1.0))
+    w1 = params.find_one_float("width1", params.find_one_float("width", 1.0))
+    n_seg_pts = 16  # subdivisions per cubic segment
+    verts_all, uvs_all = [], []
+    n_curves = (len(cps) - 1) // 3  # chained cubic segments share endpoints
+    for ci in range(max(n_curves, 1)):
+        p0, p1, p2, p3 = cps[3 * ci : 3 * ci + 4]
+        t = np.linspace(0.0, 1.0, n_seg_pts + 1)[:, None]
+        b = (
+            (1 - t) ** 3 * p0
+            + 3 * (1 - t) ** 2 * t * p1
+            + 3 * (1 - t) * t * t * p2
+            + t ** 3 * p3
+        )  # (n+1, 3)
+        tan = (
+            3 * (1 - t) ** 2 * (p1 - p0)
+            + 6 * (1 - t) * t * (p2 - p1)
+            + 3 * t * t * (p3 - p2)
+        )
+        tan /= np.maximum(np.linalg.norm(tan, axis=-1, keepdims=True), 1e-12)
+        # ribbon frame: side = tangent x reference, with a per-point
+        # fallback axis where the tangent turns parallel to the primary
+        # reference (a single t=0-derived axis degenerates there)
+        ref = np.eye(3)[np.argmin(np.abs(tan[0]))]
+        side = np.cross(tan, ref)
+        nrm = np.linalg.norm(side, axis=-1, keepdims=True)
+        alt = np.eye(3)[(np.argmin(np.abs(tan[0])) + 1) % 3]
+        side_alt = np.cross(tan, alt)
+        bad = nrm < 1e-6
+        side = np.where(bad, side_alt, side)
+        side /= np.maximum(np.linalg.norm(side, axis=-1, keepdims=True), 1e-12)
+        u_glob = (ci + t[:, 0]) / max(n_curves, 1)
+        half_w = 0.5 * ((1 - u_glob) * w0 + u_glob * w1)[:, None]
+        left = b - side * half_w
+        right = b + side * half_w
+        pts = np.stack([left, right], axis=1)  # (n+1, 2, 3)
+        for k in range(n_seg_pts):
+            a0, a1 = pts[k, 0], pts[k, 1]
+            b0_, b1_ = pts[k + 1, 0], pts[k + 1, 1]
+            verts_all += [[a0, a1, b1_], [a0, b1_, b0_]]
+            ua, ub = u_glob[k], u_glob[k + 1]
+            uvs_all += [
+                [[ua, 0], [ua, 1], [ub, 1]],
+                [[ua, 0], [ub, 1], [ub, 0]],
+            ]
+    return (
+        np.asarray(verts_all, np.float64),
+        None,
+        np.asarray(uvs_all, np.float64),
+    )
+
+
 _TESSELATORS = {
     "trianglemesh": _tess_mesh,
     "plymesh": _tess_ply,
+    "curve": _tess_curve,
     "sphere": _tess_sphere,
     "disk": _tess_disk,
     "cylinder": _tess_cylinder,
