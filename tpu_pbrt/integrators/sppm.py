@@ -18,10 +18,13 @@ TPU-first redesign of the two racy structures (SURVEY.md §5.2, §7 stage 8):
 - pbrt's AtomicFloat Phi[3] accumulation becomes a dense masked
   sum over the scanned run slots.
 - cross-device photon exchange (the fork's "global ray sort + photon
-  atomics" axis): with a device mesh, pixels AND photons are sharded;
-  each device's deposits are exchanged with jax.lax.all_gather over ICI
-  so every device gathers its own visible points against the full photon
-  set (parallel/mesh.py holds the mesh machinery).
+  atomics" axis): the designated seam is sharding pixels AND photons
+  over the mesh and exchanging each device's deposits with
+  jax.lax.all_gather over ICI so every device gathers its own visible
+  points against the full photon set. NOT YET WIRED: render() currently
+  runs single-device (a passed mesh is ignored); see README known
+  limitations.
+
 
 Capacity note: every cell run is scanned to EXHAUSTION — a while_loop
 walks each run in `scancap`-photon chunks, so nothing is ever dropped
