@@ -1,0 +1,436 @@
+#!/usr/bin/env python
+"""Round-4 truthful microbenchmarks for the stream tracer hot spots.
+
+Measurement rules (see memory: tpu-measurement-pitfalls):
+- the tunnel memoizes identical (executable, inputs) dispatches -> every
+  repetition must differ (chained fori_loop with iteration-dependent data)
+- block_until_ready does not force execution -> time a HOST FETCH of a
+  scalar derived from the output
+- cancel the ~100 ms tunnel RTT by differencing n=1 vs n=N chained reps
+
+Usage: python tools/microbench4.py [which ...]
+  which in {wave, sort, part, scatter, gather}; default all
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def chained(body, init, n):
+    """Run body n times chained inside one jit; return final carry."""
+
+    @partial(jax.jit, static_argnames=("reps",))
+    def run(c, reps):
+        return jax.lax.fori_loop(0, reps, body, c)
+
+    def probe(out):
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        return float(jnp.sum(jnp.ravel(leaf)[:1]))
+
+    # warm both executables
+    probe(run(init, 1))
+    probe(run(init, n))
+
+    def fetch(reps):
+        t0 = time.time()
+        probe(run(init, reps))
+        return time.time() - t0
+
+    t1 = min(fetch(1) for _ in range(3))
+    tn = min(fetch(n) for _ in range(3))
+    return (tn - t1) / (n - 1)
+
+
+def bench_sort(S=1 << 17):
+    """EXPAND's compaction sort: 8S elements."""
+    n = 8 * S
+    key0 = jnp.asarray(np.random.default_rng(0).normal(size=n), jnp.float32)
+    a = jnp.arange(n, dtype=jnp.int32)
+
+    def body4(i, c):
+        k, x, y, z = c
+        k = k + jnp.float32(1e-6) * i  # mutate so reps differ
+        k2, x2, y2, z2 = jax.lax.sort([k, x, y, z], num_keys=1)
+        return (k2, x2, y2, z2)
+
+    t4 = chained(body4, (key0, a, a, a), 8)
+    print(f"sort {n} el, 4 arrays: {t4*1e3:.2f} ms")
+
+    def body2(i, c):
+        k, x = c
+        k = k + jnp.float32(1e-6) * i
+        k2, x2 = jax.lax.sort([k, x], num_keys=1)
+        return (k2, x2)
+
+    t2 = chained(body2, (key0, a), 8)
+    print(f"sort {n} el, 2 arrays (key+idx): {t2*1e3:.2f} ms")
+
+    def body2g(i, c):
+        k, x = c
+        k = k + jnp.float32(1e-6) * i
+        k2, idx = jax.lax.sort([k, a], num_keys=1)
+        # 3 payload gathers like the real use
+        p1 = jnp.take(x, idx)
+        p2 = jnp.take(x, idx)
+        p3 = jnp.take(x, idx)
+        return (k2 + (p1 + p2 + p3).astype(jnp.float32) * 0, x)
+
+    t2g = chained(body2g, (key0, a), 8)
+    print(f"sort 2 arrays + 3 gathers: {t2g*1e3:.2f} ms")
+
+
+def bench_sort3(S=1 << 17):
+    """3-array int-key sort (tn packed into the key) vs the 4-array sort."""
+    n = 8 * S
+    key0 = jnp.asarray(
+        np.random.default_rng(0).integers(-(2**31), 2**31 - 1, n), jnp.int32)
+    a = jnp.arange(n, dtype=jnp.int32)
+
+    def body3(i, c):
+        k, x, y = c
+        k = k + i
+        k2, x2, y2 = jax.lax.sort([k, x, y], num_keys=1)
+        return (k2, x2, y2)
+
+    t3 = chained(body3, (key0, a, a), 8)
+    print(f"sort {n} el, 3 arrays (i32 key): {t3*1e3:.2f} ms")
+
+    def body4(i, c):
+        k, x, y, z = c
+        k = k + i
+        k2, x2, y2, z2 = jax.lax.sort([k, x, y, z], num_keys=1)
+        return (k2, x2, y2, z2)
+
+    t4 = chained(body4, (key0, a, a, a), 8)
+    print(f"sort {n} el, 4 arrays (i32 key): {t4*1e3:.2f} ms")
+
+
+def bench_boxfetch(S=1 << 17, N=512):
+    """Box-table fetch variants for EXPAND. Table: N nodes x 8 children x
+    6 floats. Need output lane-major (6, 8, S)."""
+    rng = np.random.default_rng(1)
+    boxT = jnp.asarray(rng.normal(size=(6, 8, N)), jnp.float32)
+    box_rows = jnp.asarray(rng.normal(size=(N, 48)), jnp.float32)
+    idx0 = jnp.asarray(rng.integers(0, N, S), jnp.int32)
+
+    def body_lane(i, c):
+        acc, idx = c
+        idx = (idx + i) % N
+        nb = jnp.take(boxT, idx, axis=2)  # (6,8,S)
+        return (acc + jnp.sum(nb[:, :, :8]), idx)
+
+    t = chained(body_lane, (jnp.float32(0), idx0), 8)
+    print(f"box fetch lane-take (6,8,N)->axis2, S={S}: {t*1e3:.2f} ms")
+
+    def body_row(i, c):
+        acc, idx = c
+        idx = (idx + i) % N
+        rows = jnp.take(box_rows, idx, axis=0)  # (S,48)
+        nb = rows.T.reshape(6, 8, S)  # transpose to lane-major
+        return (acc + jnp.sum(nb[:, :, :8]), idx)
+
+    t = chained(body_row, (jnp.float32(0), idx0), 8)
+    print(f"box fetch row-take (N,48)+transpose: {t*1e3:.2f} ms")
+
+    def body_onehot(i, c):
+        acc, idx = c
+        idx = (idx + i) % N
+        oh = jax.nn.one_hot(idx, N, dtype=jnp.float32)  # (S,N)
+        rows = jnp.dot(oh, box_rows,
+                       precision=jax.lax.Precision.DEFAULT)  # (S,48)
+        nb = rows.T.reshape(6, 8, S)
+        return (acc + jnp.sum(nb[:, :, :8]), idx)
+
+    t = chained(body_onehot, (jnp.float32(0), idx0), 8)
+    print(f"box fetch one-hot matmul (S,{N})@({N},48)+T: {t*1e3:.2f} ms")
+
+    def body_onehot_T(i, c):
+        acc, idx = c
+        idx = (idx + i) % N
+        # build one-hot transposed: (N, S) @ rows.T (48,N) x (N,S)
+        oh = (idx[None, :] == jnp.arange(N)[:, None]).astype(jnp.float32)
+        nb = jnp.dot(box_rows.T, oh).reshape(6, 8, S)  # (48,S)
+        return (acc + jnp.sum(nb[:, :, :8]), idx)
+
+    t = chained(body_onehot_T, (jnp.float32(0), idx0), 8)
+    print(f"box fetch one-hot matmul lane-major (48,{N})@({N},S): {t*1e3:.2f} ms")
+
+
+def bench_rayfetch(S=1 << 17, R=1 << 20):
+    rng = np.random.default_rng(2)
+    o_invT = jnp.asarray(rng.normal(size=(6, R)), jnp.float32)
+    o_inv_rows = jnp.asarray(rng.normal(size=(R, 6)), jnp.float32)
+    idx0 = jnp.asarray(rng.integers(0, R, S), jnp.int32)
+
+    def body_lane(i, c):
+        acc, idx = c
+        idx = (idx + i) % R
+        ray6 = jnp.take(o_invT, idx, axis=1)  # (6,S)
+        return (acc + jnp.sum(ray6[:, :8]), idx)
+
+    t = chained(body_lane, (jnp.float32(0), idx0), 8)
+    print(f"ray fetch lane-take (6,R)->axis1, S={S}: {t*1e3:.2f} ms")
+
+    def body_row(i, c):
+        acc, idx = c
+        idx = (idx + i) % R
+        rows = jnp.take(o_inv_rows, idx, axis=0)  # (S,6)
+        ray6 = rows.T
+        return (acc + jnp.sum(ray6[:, :8]), idx)
+
+    t = chained(body_row, (jnp.float32(0), idx0), 8)
+    print(f"ray fetch row-take (R,6)+transpose: {t*1e3:.2f} ms")
+
+
+def bench_rayflat(S=1 << 17, R=1 << 20):
+    """6 separate flat 1D gathers (fast path?) vs the 2D takes."""
+    rng = np.random.default_rng(5)
+    cols = [jnp.asarray(rng.normal(size=(R,)), jnp.float32) for _ in range(6)]
+    idx0 = jnp.asarray(rng.integers(0, R, S), jnp.int32)
+
+    def body(i, c):
+        acc, idx = c
+        idx = (idx + i) % R
+        vals = [jnp.take(col, idx) for col in cols]
+        return (acc + sum(jnp.sum(v[:8]) for v in vals), idx)
+
+    t = chained(body, (jnp.float32(0), idx0), 8)
+    print(f"ray fetch 6x flat-1D take, S={S}: {t*1e3:.2f} ms")
+
+
+def bench_scatter_variants(R=1 << 20, U=1 << 16):
+    rng = np.random.default_rng(2)
+    t0 = jnp.full((R,), 1e9, jnp.float32)
+    rid_rand = jnp.asarray(rng.integers(0, R, U), jnp.int32)
+    val0 = jnp.asarray(rng.normal(size=U).astype(np.float32))
+
+    def body_min_only(i, c):
+        t, rid = c
+        rid = (rid + i) % R
+        t2 = t.at[rid].min(val0 + i.astype(jnp.float32))
+        return (t2, rid)
+
+    t = chained(body_min_only, (t0, rid_rand), 8)
+    print(f"scatter-min only, {U} random into {R}: {t*1e3:.2f} ms")
+
+    rid_sorted = jnp.sort(rid_rand)
+
+    def body_min_sorted(i, c):
+        t, rid = c
+        # keep sorted: add i then re-not... adding same i keeps sorted
+        rid2 = jnp.minimum(rid + i, R - 1)
+        t2 = t.at[rid2].min(val0 + i.astype(jnp.float32))
+        return (t2, rid)
+
+    t = chained(body_min_sorted, (t0, rid_sorted), 8)
+    print(f"scatter-min sorted idx: {t*1e3:.2f} ms")
+
+    def body_seg(i, c):
+        t, rid = c
+        rid2 = (rid + i) % R
+        v = val0 + i.astype(jnp.float32)
+        # sort candidates by ray (i32 fast path), segment-min via
+        # reverse-cummin over runs, then scatter only run heads
+        r_s, v_s = jax.lax.sort([rid2, _bits_f(v)], num_keys=1)
+        v_s = _unbits_f(v_s)
+        # reverse cumulative min within equal-rid runs: associative scan
+        def comb(a, b):
+            ra, va = a
+            rb, vb = b
+            keep = ra == rb
+            return (ra, jnp.where(keep, jnp.minimum(va, vb), va))
+        rr, vv = jax.lax.associative_scan(
+            comb, (r_s[::-1], v_s[::-1]))
+        rr, vv = rr[::-1], vv[::-1]
+        head = jnp.concatenate(
+            [jnp.ones((1,), bool), r_s[1:] != r_s[:-1]])
+        sel = jnp.where(head, r_s, R)
+        t2 = t.at[sel].min(vv, mode="drop")
+        return (t2, rid)
+
+    t = chained(body_seg, (t0, rid_rand), 8)
+    print(f"sort+segmin+scatter-min heads: {t*1e3:.2f} ms")
+
+
+def bench_rowwidth(S=1 << 17, R=1 << 20):
+    """Row-gather cost vs row width and index sortedness."""
+    rng = np.random.default_rng(9)
+    idx_r = jnp.asarray(rng.integers(0, R, S), jnp.int32)
+    idx_s = jnp.sort(idx_r)
+    for W in (1, 8, 32, 128):
+        tab = jnp.asarray(rng.normal(size=(R, W)), jnp.float32)
+
+        def body(i, c, tab=tab):
+            acc, idx = c
+            idx = (idx + i) % R
+            g = tab[idx] if W > 1 else jnp.take(tab[:, 0], idx)
+            return (acc + jnp.sum(jnp.ravel(g)[:8]), idx)
+
+        tr = chained(body, (jnp.float32(0), idx_r), 8)
+        ts = chained(body, (jnp.float32(0), idx_s), 8)
+        print(f"row gather W={W:3d}: random {tr*1e3:6.2f} ms | "
+              f"sorted-ish {ts*1e3:6.2f} ms ({S} rows)")
+
+
+def bench_sort_scale():
+    for logn in (17, 20, 23):
+        n = 1 << logn
+        key0 = jnp.asarray(
+            np.random.default_rng(0).integers(-(2**31), 2**31 - 1, n),
+            jnp.int32)
+        a = jnp.arange(n, dtype=jnp.int32)
+
+        def body3(i, c):
+            k, x, y = c
+            k = k + i
+            return tuple(jax.lax.sort([k, x, y], num_keys=1))
+
+        t3 = chained(body3, (key0, a, a), 6)
+        print(f"sort {n} el 3arr i32: {t3*1e3:.2f} ms ({t3/n*1e9:.2f} ns/el)")
+
+
+def bench_i64_scatter(R=1 << 20, U=1 << 16):
+    rng = np.random.default_rng(7)
+    t0 = jnp.full((R,), (1 << 62), jnp.int64)
+    rid0 = jnp.asarray(rng.integers(0, R, U), jnp.int32)
+    val0 = jnp.asarray(rng.integers(0, 1 << 40, U), jnp.int64)
+
+    def body(i, c):
+        t, rid = c
+        rid = (rid + i) % R
+        t2 = t.at[rid].min(val0 + i.astype(jnp.int64))
+        return (t2, rid)
+
+    try:
+        t = chained(body, (t0, rid0), 8)
+        print(f"i64 scatter-min {U} into {R}: {t*1e3:.2f} ms")
+    except Exception as e:  # noqa: BLE001
+        print(f"i64 scatter-min failed: {type(e).__name__}: {e}")
+
+
+def _bits_f(x):
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
+
+
+def _unbits_f(x):
+    return jax.lax.bitcast_convert_type(x, jnp.float32)
+
+
+def bench_scatter(R=1 << 20, U=1 << 16):
+    t0 = jnp.full((R,), 1e9, jnp.float32)
+    rng = np.random.default_rng(2)
+    rid0 = jnp.asarray(rng.integers(0, R, U), jnp.int32)
+    val0 = jnp.asarray(rng.normal(size=U), jnp.float32)
+
+    def body(i, c):
+        t, rid = c
+        rid = (rid + i) % R
+        t2 = t.at[rid].min(val0 + i.astype(jnp.float32))
+        sel = jnp.where(val0 + i.astype(jnp.float32) == t2[rid], rid, R)
+        t3 = t2.at[sel].set(0.5, mode="drop")
+        return (t3, rid)
+
+    t = chained(body, (t0, rid0), 8)
+    print(f"scatter-min+set {U} upd into {R}: {t*1e3:.2f} ms")
+
+
+def bench_gather(C=300, L=512, CH=512):
+    feat0 = jnp.asarray(
+        np.random.default_rng(3).normal(size=(C, 16, 4 * L)), jnp.float32)
+    tids0 = jnp.asarray(np.random.default_rng(4).integers(0, C, CH), jnp.int32)
+
+    def body(i, c):
+        acc, tids = c
+        tids = (tids + i) % C
+        g = feat0[tids]  # (CH, 16, 4L)
+        return (acc + jnp.sum(g[:, 0, :4]), tids)
+
+    t = chained(body, (jnp.float32(0), tids0), 8)
+    mb = CH * 16 * 4 * L * 4 / 1e6
+    print(f"featT gather ({CH},16,{4*L}) = {mb:.0f} MB: {t*1e3:.2f} ms "
+          f"-> {mb/1e3/t:.0f} GB/s")
+
+
+def bench_wave():
+    from tpu_pbrt.scenes import compile_api, make_killeroo_like
+    from tpu_pbrt.cameras import generate_rays
+    from tpu_pbrt.accel.stream import stream_intersect, stream_traverse_stats
+
+    api = make_killeroo_like(res=512, spp=64)
+    scene, _ = compile_api(api)
+    dev = scene.dev
+    tp = dev["tstream"]
+    R = 1 << 20
+    k = jnp.arange(R, dtype=jnp.int32)
+    pix = k % (512 * 512)
+    pf = jnp.stack([(pix % 512).astype(jnp.float32) + 0.5,
+                    (pix // 512).astype(jnp.float32) + 0.5], -1)
+    o, d, _ = generate_rays(scene.camera, pf, jnp.zeros_like(pf))
+
+    @partial(jax.jit, static_argnames=("reps",))
+    def run(o, d, reps):
+        def body(i, acc):
+            # jitter origins so every wave differs (anti-memoization)
+            oo = o + jnp.float32(1e-4) * (i + 1)
+            h = stream_intersect(tp, dev["tri_verts"], oo, d, jnp.inf)
+            return acc + jnp.sum(h.t[jnp.isfinite(h.t)].astype(jnp.float64)
+                                 if False else jnp.where(
+                                     jnp.isfinite(h.t), h.t, 0.0))
+        return jax.lax.fori_loop(0, reps, body, jnp.float32(0))
+
+    float(run(o, d, 1))
+    float(run(o, d, 3))
+
+    def fetch(reps):
+        t0 = time.time()
+        float(run(o, d, reps))
+        return time.time() - t0
+
+    t1 = min(fetch(1) for _ in range(2))
+    t3 = min(fetch(3) for _ in range(2))
+    per = (t3 - t1) / 2
+    print(f"camera wave 1M rays: {per*1e3:.0f} ms -> {R/per/1e6:.2f} Mray/s")
+
+    n_exp, n_tl, n_drop, iters = jax.jit(
+        stream_traverse_stats, static_argnames=("any_hit",)
+    )(tp, o, d, jnp.inf, any_hit=False)
+    print(f"  pairs={int(n_exp)} leaf-slots={int(n_tl)} drops={int(n_drop)} "
+          f"iters={int(iters)}")
+
+
+if __name__ == "__main__":
+    which = sys.argv[1:] or ["wave", "sort", "sort3", "box", "ray",
+                             "scatter", "gather"]
+    print(f"backend={jax.default_backend()}")
+    if "wave" in which:
+        bench_wave()
+    if "sort" in which:
+        bench_sort()
+    if "sort3" in which:
+        bench_sort3()
+    if "box" in which:
+        bench_boxfetch()
+    if "ray" in which:
+        bench_rayfetch()
+    if "rayflat" in which:
+        bench_rayflat()
+    if "rowwidth" in which:
+        bench_rowwidth()
+    if "sortscale" in which:
+        bench_sort_scale()
+    if "i64" in which:
+        bench_i64_scatter()
+    if "scatterv" in which:
+        bench_scatter_variants()
+    if "scatter" in which:
+        bench_scatter()
+    if "gather" in which:
+        bench_gather()

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Per-HLO-op TPU profile of one camera wave (memory: xprof recipe).
+
+Usage: python tools/xprof_wave.py [top_n]
+"""
+
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def profile_fn(fn, *args, tdir="/tmp/xprof_wave", top_n=25):
+    """Run fn twice (warm, then traced), print per-HLO self-time table."""
+    out = fn(*args)
+    jax.tree_util.tree_map(
+        lambda x: float(jnp.sum(jnp.where(jnp.isfinite(x), x, 0.0)))
+        if hasattr(x, "dtype") and x.dtype == jnp.float32 else None, out)
+    os.system(f"rm -rf {tdir}")
+    jax.profiler.start_trace(tdir)
+    out = fn(*args)
+    jax.tree_util.tree_map(
+        lambda x: float(jnp.sum(jnp.where(jnp.isfinite(x), x, 0.0)))
+        if hasattr(x, "dtype") and x.dtype == jnp.float32 else None, out)
+    jax.profiler.stop_trace()
+
+    files = glob.glob(f"{tdir}/plugins/profile/*/*.xplane.pb")
+    from xprof.convert.raw_to_tool_data import xspace_to_tool_data
+
+    data, _ = xspace_to_tool_data(files, "hlo_stats", {})
+    tbl = json.loads(data.decode())
+    if isinstance(tbl, list):
+        tbl = tbl[0]
+    cols = [c["id"] for c in tbl["cols"]]
+    rows = [dict(zip(cols, [x.get("v") for x in r["c"]])) for r in tbl["rows"]]
+    tot = sum(r["total_self_time"] for r in rows)
+    print(f"device total: {tot/1e3:.0f} ms")
+    for r in sorted(rows, key=lambda r: -r["total_self_time"])[:top_n]:
+        expr = r["hlo_op_expression"][:100].replace(chr(10), " ")
+        print(f"{r['total_self_time']/1e3:7.1f}ms n={r['occurrences']:5.0f} "
+              f"{r['category'][:13]:13s} bw={r['measured_memory_bw']:7.1f} "
+              f"{expr}")
+    return rows
+
+
+def main():
+    top_n = int(sys.argv[1]) if len(sys.argv) > 1 else 25
+    from tpu_pbrt.scenes import compile_api, make_killeroo_like
+    from tpu_pbrt.cameras import generate_rays
+    from tpu_pbrt.accel.stream import stream_intersect
+
+    api = make_killeroo_like(res=512, spp=64)
+    scene, _ = compile_api(api)
+    dev = scene.dev
+    tp = dev["tstream"]
+    print(f"treelets={tp.n_treelets} top_nodes={tp.top.child_idx.shape[0]}")
+    R = 1 << 20
+    k = jnp.arange(R, dtype=jnp.int32)
+    pix = k % (512 * 512)
+    pf = jnp.stack([(pix % 512).astype(jnp.float32) + 0.5,
+                    (pix // 512).astype(jnp.float32) + 0.5], -1)
+    o, d, _ = generate_rays(scene.camera, pf, jnp.zeros_like(pf))
+
+    def wave(o):
+        h = stream_intersect(tp, dev["tri_verts"], o, d, jnp.inf)
+        return h.t
+
+    profile_fn(lambda: wave(o + 1e-4), top_n=top_n)
+
+
+if __name__ == "__main__":
+    main()

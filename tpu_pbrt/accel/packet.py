@@ -139,12 +139,12 @@ def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool):
         # than its farthest lane hit, every later one is too
         live = valid & (s.leaf_tn[:, k] <= t_pkt) & (tid >= 0)
 
-        W = tp.feat[jnp.where(live, tid, 0)]  # (P,4L,16)
+        WT = tp.featT[jnp.where(live, tid, 0)]  # (P,16,4L)
         ctr = tp.center[jnp.where(live, tid, 0)]  # (P,3)
         off = tp.offset[jnp.where(live, tid, 0)]  # (P,)
         phi = ray_features(o - ctr[:, None, :], d)  # (P,LANE,16)
         out = jnp.einsum(
-            "plf,pcf->plc", phi, W, precision=jax.lax.Precision.HIGHEST
+            "plf,pfc->plc", phi, WT, precision=jax.lax.Precision.HIGHEST
         )
         t_new, k_loc, b0, b1 = decode_outputs(out, L, s.t)
         better = live[:, None] & jnp.isfinite(t_new) & (t_new < s.t)

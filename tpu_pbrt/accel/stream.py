@@ -2,7 +2,7 @@
 
 Capability match for pbrt-v3 src/accelerators/bvh.cpp
 BVHAccel::Intersect/IntersectP (same closest-hit/any-hit semantics over the
-same SAH tree), re-architected a second time for TPU execution behavior.
+same SAH tree), re-architected a third time for TPU execution behavior.
 
 Why not the packet walk (accel/packet.py): packets amortize node fetches
 only while the 128 rays in a packet agree on a traversal path. Bounce rays
@@ -17,48 +17,70 @@ few hundred bytes per ray — far below the row sizes TPU memory wants.
 
 The stream design has NO per-ray control flow at all. Traversal state is
 one flat LIFO worklist of (ray, node, t_entry) pairs shared by the whole
-wave, processed in large dense slabs. The primitive costs measured on this
-v5e (in-jit repetition, amortizing the ~100 ms tunnel round-trip) dictate
-the shape of every step: scatters ~10-35 ms per 512k elements, sorts ~2 ms
-per 512k keys, row gathers ~8 ns/row, contiguous dynamic slices and dense
-vector/MXU math effectively free. So the design is SORT-BASED and
-scatter-free everywhere a sort can stand in for a scatter:
+wave, processed in large dense slabs. Primitive costs measured on this
+v5e (distinct inputs per dispatch, host-fetch timing — the tunnel
+memoizes repeats) dictate the shape of every step:
 
-- EXPAND pops a slab of SLAB pairs at once (one contiguous dynamic_slice),
-  culls pairs whose recorded entry distance already exceeds their ray's
-  current hit, slab-tests each pair's ray against its node's 8 child boxes
-  in one dense (SLAB, 8) test — one packed (8,6)-float box row and one
-  packed (6,)-float ray row per pair — then compacts the 8*SLAB child
-  candidates with ONE sort on a single f32 key: hit leaves sort to the
-  front (key -inf), hit interior children next ordered far-to-near (key
-  -t_entry), everything else to the back (key +inf). The sorted prefix is
-  appended to the leaf buffer and the interior span is pushed onto the
-  stack with two contiguous dynamic_update_slices — no scatter, and the
-  global far-to-near order means the next pop takes the wave's nearest
-  subtrees first (stronger front-to-back culling than per-node child
-  ordering).
-- FLUSH runs when the leaf buffer is nearly full (or the stack empties):
-  it sorts the buffered (ray, treelet) pairs by treelet id, so each
-  treelet's rays form a contiguous run; block starts come from a
-  searchsorted over the run ids (binary search, not scatter), and each
-  128-ray block is intersected against its treelet's triangles in one MXU
-  feature matmul (accel/mxu.py): (128, 16) ray features x (16, 4L)
-  per-treelet Moller-Trumbore weights. Closest hits merge into per-ray
-  state by scatter-min (+ an equality-select scatter for the payload, the
-  standard two-pass argmin trick) — the one place a scatter is
-  unavoidable, paid per tested block slot.
+- jax.lax.sort hits a FAST radix-like path only for INT32 keys with at
+  most 3 operand arrays (~1 ms / 1M elements); a float key or a 4th
+  array falls back to a comparator sort (~7 ms / 1M). Every sort in this
+  file therefore uses a single packed-i32 key and <= 3 arrays.
+- random gathers cost ~10-30 ns per INDEX (layout-insensitive), but
+  nearly-sorted indices approach ~1 ns/element; scatters are worst of
+  all. Gathers from SMALL tables are instead computed on the MXU as a
+  one-hot matmul (~0.4 ms for 131k lookups of a 48-float row vs ~8 ms
+  for the native gather).
 
-Sequential depth per wave is therefore ~(total pairs / SLAB) big dense
-steps instead of per-ray tree depth times worst-lane divergence, and leaf
-work lands on the MXU in (128, 16) @ (16, 4L) tiles regardless of ray
-order. Ray coherence changes only the pair COUNT (coherent rays produce
-fewer pairs), never the execution shape — the design goal for a wavefront
-path tracer whose bounce waves are inherently incoherent.
+EXPAND pops a slab of SLAB pairs at once (one contiguous dynamic_slice),
+culls pairs whose recorded entry distance already exceeds their ray's
+current hit, slab-tests each pair's ray against its node's 8 child boxes
+in one dense (8, SLAB) lane-major test. The node's 8 child boxes AND the
+8 child codes (as two exact 16-bit halves) ride ONE one-hot matmul:
+(64, N) static table @ (N, S) one-hot at Precision.HIGHEST — exact for
+the integer rows, and within 1 ulp for the box rows, absorbed by the
+slab test's _BOX_EPS widening. The 8*SLAB child candidates are then
+compacted with ONE 2-array int-key sort whose packed key is
 
-The acceleration structure is the same two-level TreeletPack as the packet
-walk (accel/treelet.py) with fatter leaves (STREAM_LEAF_TRIS): the
-MXU makes triangle tests nearly free, so trading deeper trees for fatter
-matmuls moves work from the latency-bound worklist to the compute units.
+    leaf:     ray                                  (sorts first)
+    interior: 2^30 + (ray << TN_BITS) + ~quant(t_entry)
+    dead:     INT32_MAX
+
+so leaves compact to the front (appended to the leaf buffer with one
+contiguous write), interiors land grouped BY RAY with each ray's nearest
+children pushed on top of the LIFO stack (per-ray front-to-back order —
+stronger culling than any global distance order, because only a ray's
+OWN near leaves can tighten its t), and the ray-major order makes every
+downstream per-ray gather (o/inv_d/t) nearly sorted. The entry distance
+lives ONLY in the key's low quantized bits: the pop-side cull rebuilds
+a conservative underestimate from them (mantissa tail zero-filled), so
+dropping the exact f32 plane costs a fraction of a percent of extra
+pairs but removes a third sort array and a whole stack plane.
+
+FLUSH runs when the leaf buffer is nearly full (or the stack empties):
+it sorts the buffered (ray, treelet) pairs by a packed (treelet << RAY_
+BITS | ray) key, so each treelet's rays form one contiguous, ray-sorted
+run; block starts are recovered with a second single-array int sort
+(position-of-k-th-set-bit via sort — searchsorted is ~100x slower on
+TPU), and each 128-ray block is intersected against its treelet's
+triangles in one MXU feature matmul (accel/mxu.py): (128, 16) ray
+features x (16, 4L) per-treelet Moller-Trumbore weights. Closest hits
+merge per chunk by sorting the chunk's candidates on a packed
+(ray, t-bits) key pair and scattering only each ray-run's HEAD (its
+argmin): two small mostly-dropped scatters at sorted unique indices
+replace the per-slot scatter-min + equality-select pair that dominated
+the round-3 profile.
+
+Sequential depth per wave is ~(total pairs / SLAB) big dense steps, and
+leaf work lands on the MXU in (128, 16) @ (16, 4L) tiles regardless of
+ray order. Ray coherence changes only the pair COUNT, never the
+execution shape. Dead lanes (t_max <= 0) are sorted out of the initial
+stack, so bounce/shadow waves cost ~(live rays), not R.
+
+The acceleration structure is the same two-level TreeletPack as the
+packet walk (accel/treelet.py) with fatter leaves (STREAM_LEAF_TRIS):
+the MXU makes triangle tests nearly free, so trading deeper trees for
+fatter matmuls moves work from the latency-bound worklist to the
+compute units.
 """
 
 from __future__ import annotations
@@ -70,7 +92,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_pbrt.accel.mxu import decode_outputs, ray_features
+from tpu_pbrt.accel.mxu import decode_outputs
 from tpu_pbrt.accel.traverse import Hit
 from tpu_pbrt.accel.treelet import TreeletPack, decode_top_leaf
 from tpu_pbrt.accel.wide import _EMPTY, slab_test_lane_major
@@ -87,6 +109,11 @@ BLOCK = 128
 CHUNK = 512
 #: safety bound on while_loop iterations (real waves take tens to hundreds)
 _MAX_ITERS = 1 << 16
+#: above this top-node count the one-hot box matmul's N dimension costs
+#: more than the native gather it replaces
+_ONEHOT_MAX_NODES = 4096
+
+_I32_MAX = np.int32(2**31 - 1)
 
 
 def _use_pallas() -> bool:
@@ -109,16 +136,32 @@ def _use_prefetch() -> bool:
     return os.environ.get("TPU_PBRT_PREFETCH", "0") == "1"
 
 
+def _use_onehot(n_nodes: int) -> bool:
+    import os
+
+    if os.environ.get("TPU_PBRT_ONEHOT", "1") == "0":
+        return False
+    return n_nodes <= _ONEHOT_MAX_NODES
+
+
 class _SState(NamedTuple):
-    t: jnp.ndarray  # (R,) current closest hit (or t_max)
+    # Lane-major per-ray tables. Multi-row takes on this v5e cost
+    # ~2 ns per fetched ELEMENT (not per index), so each consumer gets
+    # its own 8-row table holding exactly what it reads, fetched in ONE
+    # take: rayE for EXPAND [o(0:3) inv_d(3:6) t(6) pad], rayF for FLUSH
+    # [o(0:3) d(3:6) t(6) pad]. Row 6 (the ray's current closest hit) is
+    # kept identical in both: the merge updates it once via a 1D scatter
+    # and writes it back with two contiguous dynamic_update_slices
+    # (carrying a separate (R,) t array instead made XLA re-lay-out the
+    # tables every iteration, ~130 ms/wave).
+    rayE: jnp.ndarray  # (8, R) f32
+    rayF: jnp.ndarray  # (8, R) f32
     prim: jnp.ndarray  # (R,) i32 global leaf-order triangle id, -1 miss
-    stk_node: jnp.ndarray  # (W + headroom,) i32 top-tree node / treelet code
-    stk_ray: jnp.ndarray  # (W + headroom,) i32 ray ids
-    stk_tn: jnp.ndarray  # (W + headroom,) i32 bitcast f32 entry distance
+    stk_key: jnp.ndarray  # (W + headroom,) i32 packed (2^30 | ray<<TN | ~qtn)
+    stk_code: jnp.ndarray  # (W + headroom,) i32 top-tree node id
     n_stk: jnp.ndarray  # i32
+    lf_ray: jnp.ndarray  # (LB + headroom,) i32 ray ids (= leaf sort keys)
     lf_tid: jnp.ndarray  # (LB + headroom,) i32 treelet ids
-    lf_ray: jnp.ndarray  # (LB + headroom,) i32
-    lf_tn: jnp.ndarray  # (LB + headroom,) i32 bitcast f32
     n_lf: jnp.ndarray  # i32
     n_drop: jnp.ndarray  # i32 pairs lost to capacity (tests assert 0)
     n_exp: jnp.ndarray  # i32 stat: pairs expanded
@@ -130,11 +173,10 @@ def _sizes(R: int):
     """Static worklist sizes for a wave of R rays.
 
     Slab-size tradeoff, measured on this v5e (1M-ray camera wave):
-    bigger slabs amortize sort dispatch cost (128k-key sort 3.6 ms vs
-    1M-key 5.1 ms) but DELAY flushes, so per-ray closest-t stays loose
-    longer and the wave expands more pairs (131k slab: 6.7M pairs,
-    1.29 s; 512k slab: 7.3M pairs, 1.53 s). The default keeps the
-    tighter-culling small slab; TPU_PBRT_SLAB overrides for experiments."""
+    bigger slabs amortize per-step dispatch cost but DELAY flushes, so
+    per-ray closest-t stays loose longer and the wave expands more
+    pairs. The default keeps the tighter-culling small slab;
+    TPU_PBRT_SLAB overrides for experiments."""
     import os
 
     cap = int(os.environ.get("TPU_PBRT_SLAB", 1 << 17))
@@ -152,31 +194,97 @@ def _unbits(x):
     return jax.lax.bitcast_convert_type(x, jnp.float32)
 
 
-def _expand(tp: TreeletPack, boxT, cidT, o_invT, s: _SState, slab: int,
-            w: int, lb: int, any_hit: bool):
+def _ray_bits(R: int) -> int:
+    rb = max(1, int(np.ceil(np.log2(max(R, 2)))))
+    if rb > 29:
+        raise ValueError(
+            f"stream tracer waves are capped at 2^29 rays (got {R}); "
+            "chunk the wave at the integrator level"
+        )
+    return rb
+
+
+def _tn_bits(R: int) -> int:
+    # interior keys live in [2^30, 2^30 + 2^(rb+tn)) which must stay
+    # below INT32_MAX; rb + tn <= 29 guarantees it with room to spare
+    return max(0, min(12, 29 - _ray_bits(R)))
+
+
+def _node_table(boxT, cidT):
+    """(64, N) f32 one-hot-matmul table: rows 0..47 the 8 child boxes
+    (component-major, flattened from the caller's (6, 8, N) boxT so the
+    two fetch paths share one layout), rows 48..55 / 56..63 the child
+    codes' low/high 16-bit halves (exact in f32; reassembled bitwise).
+    +-inf box bounds are clamped to +-3e38: inf * 0.0 in the matmul
+    would poison the one-hot sum with NaN."""
+    N = boxT.shape[2]
+    box48 = jnp.clip(boxT.reshape(48, N), -3e38, 3e38)
+    lo = (cidT & 0xFFFF).astype(jnp.float32)
+    hi = ((cidT >> 16) & 0xFFFF).astype(jnp.float32)
+    return jnp.concatenate([box48, lo, hi], axis=0)  # (64, N)
+
+
+def _fetch_children(tab64, boxT, cidT, node, use_onehot: bool):
+    """Per-pair child boxes (6, 8, S) + child codes (8, S) for node ids
+    (S,). Small top trees ride the MXU (one-hot matmul); big ones fall
+    back to native gathers."""
+    S = node.shape[0]
+    N = boxT.shape[2]
+    if use_onehot:
+        oh = (node[None, :] == jnp.arange(N, dtype=jnp.int32)[:, None]).astype(
+            jnp.float32
+        )  # (N, S)
+        out = jax.lax.dot(
+            tab64, oh, precision=jax.lax.Precision.HIGHEST
+        )  # (64, S)
+        nb = out[:48].reshape(6, 8, S)
+        lo = jnp.round(out[48:56]).astype(jnp.int32)
+        hi = jnp.round(out[56:64]).astype(jnp.int32)
+        cids = (hi << 16) | lo
+    else:
+        nb = jnp.take(boxT, node, axis=2)  # (6, 8, S)
+        cids = jnp.take(cidT, node, axis=1)  # (8, S)
+    return nb, cids
+
+
+def _expand(tp: TreeletPack, tab64, boxT, cidT, s: _SState, slab: int,
+            w: int, lb: int, any_hit: bool, use_onehot: bool):
+    R = s.rayE.shape[1]
+    rb = _ray_bits(R)
+    tb = _tn_bits(R)
     start = jnp.maximum(s.n_stk - slab, 0)
     k = jnp.arange(slab, dtype=jnp.int32)
     valid = k < (s.n_stk - start)
-    node = jnp.where(valid, jax.lax.dynamic_slice(s.stk_node, (start,), (slab,)), 0)
-    rid = jnp.where(valid, jax.lax.dynamic_slice(s.stk_ray, (start,), (slab,)), 0)
-    tn_in = jnp.where(
-        valid, _unbits(jax.lax.dynamic_slice(s.stk_tn, (start,), (slab,))), jnp.inf
+    key_in = jnp.where(
+        valid, jax.lax.dynamic_slice(s.stk_key, (start,), (slab,)), _I32_MAX
     )
-    t_r = s.t[rid]
-    live = valid & (tn_in <= t_r)
+    node = jnp.where(valid, jax.lax.dynamic_slice(s.stk_code, (start,), (slab,)), 0)
+    # stack entries are always interiors: ray id sits at key bits
+    # [tb, tb+rb); the low tb bits hold the complemented quantized entry
+    # distance, reconstructed here by zero-filling the mantissa tail —
+    # a value <= the true t_entry, so the pop cull stays conservative
+    # (carrying the exact f32 cost a third sort array + stack plane)
+    rid = jnp.clip((key_in - (1 << 30)) >> tb, 0, R - 1)
+    if tb:
+        comp = (key_in - (1 << 30)) & ((1 << tb) - 1)
+        tn_in = _unbits(((1 << tb) - 1 - comp) << (31 - tb))
+    else:
+        tn_in = jnp.zeros_like(key_in, jnp.float32)
+    tn_in = jnp.where(valid & (key_in != _I32_MAX), tn_in, jnp.inf)
+    # ONE lane-axis take covers o, inv_d AND the ray's current t
+    # (per-element gather cost rules here — see rayE/rayF note)
+    rows = jnp.take(s.rayE, rid, axis=1)  # (8, S)
+    t_r = rows[6]
+    live = valid & (key_in != _I32_MAX) & (tn_in <= t_r)
     if any_hit:
         live = live & (s.prim[rid] < 0)
 
     # ---- lane-major slab tests ------------------------------------------
-    # Layout is everything here (profiled): (S, 8, 3)-shaped math puts 3
-    # on the TPU lane dimension (3/128 utilization) and its axis reductions
-    # + tiny-row gathers were ~38% of the wave. All arrays below keep the
-    # SLAB dimension minor: tables are pre-transposed to (6, 8, N)/(8, N)/
-    # (6, R) and gathered along their LAST axis, so every elementwise op
-    # and min/max chain runs on (8, S) with full lanes and no reductions.
-    nb = jnp.take(boxT, node, axis=2)  # (6, 8, S)
-    cids = jnp.take(cidT, node, axis=1)  # (8, S)
-    ray6 = jnp.take(o_invT, rid, axis=1)  # (6, S)
+    # Layout is everything here (profiled): all arrays keep the SLAB
+    # dimension minor so every elementwise op and min/max chain runs on
+    # (8, S) with full lanes and no reductions.
+    nb, cids = _fetch_children(tab64, boxT, cidT, node, use_onehot)
+    ray6 = rows[0:6]  # (6, S) o + inv_d
 
     tx0, tx1 = slab_test_lane_major(nb[0], nb[3], ray6[0][None, :], ray6[3][None, :])
     ty0, ty1 = slab_test_lane_major(nb[1], nb[4], ray6[1][None, :], ray6[4][None, :])
@@ -190,27 +298,32 @@ def _expand(tp: TreeletPack, boxT, cidT, o_invT, s: _SState, slab: int,
     is_leaf = hit8 & (cids < 0)
 
     # ---- sort-based compaction of the 8S child candidates ---------------
-    # key: leaves first (-inf), interiors far-to-near (-t_entry: the wave's
-    # NEAREST subtrees end up on top of the LIFO stack), dead last (+inf)
+    # packed i32 key (3-array int sort = the fast path; see module doc):
+    # leaves first keyed by ray alone, then interiors keyed by
+    # (ray, ~quantized t_entry) so each ray's nearest children end up on
+    # top of the LIFO stack, dead last
+    rid8 = jnp.broadcast_to(rid[None, :], cids.shape)
+    # monotone 10-bit-ish quantization of the non-negative f32 tn: its
+    # raw bits are order-preserving; keep the top tb bits (exponent +
+    # leading mantissa). These key bits are ALL that survives: the next
+    # pop's cull dequantizes them back to a conservative lower bound.
+    qtn = jax.lax.shift_right_logical(_bits(tn8), 31 - tb) if tb else 0
+    key_leaf = rid8
+    key_int = (1 << 30) + (rid8 << tb) + (((1 << tb) - 1) - qtn)
     key = jnp.where(
-        is_leaf, -jnp.inf, jnp.where(is_int, -tn8, jnp.inf)
+        is_leaf, key_leaf, jnp.where(is_int, key_int, _I32_MAX)
     ).reshape(-1)
     cand_code = jnp.where(is_leaf, decode_top_leaf(cids), cids).reshape(-1)
-    cand_ray = jnp.broadcast_to(rid[None, :], cids.shape).reshape(-1)
-    cand_tn = _bits(tn8).reshape(-1)
-    _, code_s, ray_s, tn_s = jax.lax.sort(
-        [key, cand_code, cand_ray, cand_tn], num_keys=1
-    )
+    key_s, code_s = jax.lax.sort([key, cand_code], num_keys=1)
     n_leaf = jnp.sum(is_leaf, dtype=jnp.int32)
     n_int = jnp.sum(is_int, dtype=jnp.int32)
     s8 = 8 * slab
 
-    # append the leaf prefix to the leaf buffer (contiguous write; the up
-    # to 8S garbage entries past n_leaf land in headroom/garbage region and
-    # are overwritten by the next append or masked by n_lf)
+    # append the leaf prefix to the leaf buffer (contiguous write; for
+    # leaves the sort key IS the ray id). Garbage entries past n_leaf
+    # land in headroom and are overwritten or masked by n_lf.
+    lf_ray = jax.lax.dynamic_update_slice(s.lf_ray, key_s, (s.n_lf,))
     lf_tid = jax.lax.dynamic_update_slice(s.lf_tid, code_s, (s.n_lf,))
-    lf_ray = jax.lax.dynamic_update_slice(s.lf_ray, ray_s, (s.n_lf,))
-    lf_tn = jax.lax.dynamic_update_slice(s.lf_tn, tn_s, (s.n_lf,))
     n_lf_new = s.n_lf + n_leaf
     dropped = jnp.maximum(n_lf_new - lb, 0)
     n_lf_new = jnp.minimum(n_lf_new, lb)
@@ -218,61 +331,111 @@ def _expand(tp: TreeletPack, boxT, cidT, o_invT, s: _SState, slab: int,
     # push the interior span [n_leaf, n_leaf + n_int) onto the stack: slice
     # it out of the (padded to 16S) sorted arrays at the dynamic offset,
     # then one contiguous write at the stack top
-    pad = jnp.full((s8,), _EMPTY, jnp.int32)
+    pad = jnp.full((s8,), _I32_MAX, jnp.int32)
+    int_key = jax.lax.dynamic_slice(
+        jnp.concatenate([key_s, pad]), (n_leaf,), (s8,)
+    )
     int_code = jax.lax.dynamic_slice(
         jnp.concatenate([code_s, pad]), (n_leaf,), (s8,)
     )
-    int_ray = jax.lax.dynamic_slice(
-        jnp.concatenate([ray_s, pad]), (n_leaf,), (s8,)
-    )
-    int_tn = jax.lax.dynamic_slice(
-        jnp.concatenate([tn_s, pad]), (n_leaf,), (s8,)
-    )
-    stk_node = jax.lax.dynamic_update_slice(s.stk_node, int_code, (start,))
-    stk_ray = jax.lax.dynamic_update_slice(s.stk_ray, int_ray, (start,))
-    stk_tn = jax.lax.dynamic_update_slice(s.stk_tn, int_tn, (start,))
+    stk_key = jax.lax.dynamic_update_slice(s.stk_key, int_key, (start,))
+    stk_code = jax.lax.dynamic_update_slice(s.stk_code, int_code, (start,))
     n_stk_new = start + n_int
     dropped = dropped + jnp.maximum(n_stk_new - w, 0)
     n_stk_new = jnp.minimum(n_stk_new, w)
 
     return s._replace(
-        stk_node=stk_node, stk_ray=stk_ray, stk_tn=stk_tn, n_stk=n_stk_new,
-        lf_tid=lf_tid, lf_ray=lf_ray, lf_tn=lf_tn, n_lf=n_lf_new,
+        stk_key=stk_key, stk_code=stk_code, n_stk=n_stk_new,
+        lf_ray=lf_ray, lf_tid=lf_tid, n_lf=n_lf_new,
         n_drop=s.n_drop + dropped,
         n_exp=s.n_exp + jnp.sum(live, dtype=jnp.int32),
         iters=s.iters + 1,
     )
 
 
-def _flush(tp: TreeletPack, featT_tab, oT, dT, s: _SState, lb: int,
+def _merge_chunk(rayE, rayF, prim, rid, t_loc, k_loc, off, won, R):
+    """Fold a chunk's (ray, t, prim) candidates into the per-ray best.
+
+    Sort the candidates on a (ray, t-bits) key pair — positive-f32 bits
+    are order-preserving, so two i32 keys + the i32 payload stay on the
+    int-sort fast path — then scatter only each ray-run's HEAD (its
+    argmin). A few mostly-dropped scatters at sorted, unique indices
+    replace the per-slot scatter-min + equality-select pair that
+    dominated the round-3 profile (~12x on this v5e). The updated t row
+    goes back into BOTH ray tables with contiguous
+    dynamic_update_slices."""
+    prim_cand = (off[:, None] + k_loc.astype(jnp.int32)).reshape(-1)
+    key_ray = jnp.where(won, rid, R).reshape(-1)
+    key_t = _bits(jnp.where(won, t_loc, jnp.inf)).reshape(-1)
+    r_s, t_s, p_s = jax.lax.sort([key_ray, key_t, prim_cand], num_keys=2)
+    head = jnp.concatenate(
+        [jnp.ones((1,), bool), r_s[1:] != r_s[:-1]]
+    ) & (r_s < R)
+    sel = jnp.where(head, r_s, R)
+    tv = _unbits(t_s)
+    t_row = rayF[6]
+    # ray-run head beats the stored t iff it beats the PRE-update value
+    old = t_row[jnp.clip(r_s, 0, R - 1)]
+    win = head & (tv < old)
+    t_row2 = t_row.at[sel].min(tv, mode="drop")
+    rayE2 = jax.lax.dynamic_update_slice(rayE, t_row2[None, :], (6, 0))
+    rayF2 = jax.lax.dynamic_update_slice(rayF, t_row2[None, :], (6, 0))
+    prim2 = prim.at[jnp.where(win, r_s, R)].set(p_s, mode="drop")
+    return rayE2, rayF2, prim2
+
+
+def _slice_rows(a, starts, width):
+    """(CH,) starts -> (CH, width) contiguous slices of 1-D a, as ONE
+    lax.gather with slice_sizes=(width,): the TPU lowers this as batched
+    row copies (~bandwidth), where a vmapped dynamic_slice unrolls into
+    a sequential per-row loop (~0.8 us each, profiled)."""
+    dnums = jax.lax.GatherDimensionNumbers(
+        offset_dims=(1,), collapsed_slice_dims=(), start_index_map=(0,)
+    )
+    return jax.lax.gather(
+        a, starts[:, None], dnums, slice_sizes=(width,),
+        mode=jax.lax.GatherScatterMode.CLIP,
+    )
+
+
+def _flush(tp: TreeletPack, featT_tab, s: _SState, lb: int,
            any_hit: bool):
-    R = s.t.shape[0]
+    R = s.rayE.shape[1]
+    rb = _ray_bits(R)
     C = tp.n_treelets
     L = tp.leaf_tris
     # n_lf <= lb always, so the sort/scan pipeline works on the (lb,)
     # prefix — the append headroom past lb never holds countable pairs
     lb_v = min(lb, s.lf_tid.shape[0])
     b_cap = lb_v // BLOCK + C + 2
-    # the Pallas prefetch kernel materializes no (chunk, 128, 4L) matmul
-    # output, so its chunks can be 8x larger — fewer merge scatters and
-    # searchsorted dispatches per flush. Measured on this v5e it is ~15%
-    # SLOWER end-to-end than the gathered kernel (the one-block-per-step
-    # DMA pipeline loses to XLA's batched gather), so it stays opt-in.
     use_pallas = _use_pallas()
     use_prefetch = use_pallas and _use_prefetch()
     chunk = min(CHUNK * 8 if use_prefetch else CHUNK, b_cap)
+    # pack (treelet, ray) into one i32 sort key when the id ranges allow
+    # (common case) -> single-array fast sort + ray-sorted runs; else a
+    # 2-array (tid, ray) sort
+    packed_key = C < (1 << max(31 - rb, 0))
 
     idx = jnp.arange(lb_v, dtype=jnp.int32)
-    tn0 = _unbits(s.lf_tn[:lb_v])
     ray_c = jnp.clip(s.lf_ray[:lb_v], 0, R - 1)
-    live = (idx < s.n_lf) & (s.lf_tid[:lb_v] >= 0) & (tn0 <= s.t[ray_c])
-    if any_hit:
-        live = live & (s.prim[ray_c] < 0)
-    key = jnp.where(live, s.lf_tid[:lb_v], C)
-    key_s, rid_s = jax.lax.sort([key, ray_c], num_keys=1)
-    valid_s = key_s < C
-    prev = jnp.concatenate([jnp.full((1,), -1, key_s.dtype), key_s[:-1]])
-    newrun = valid_s & (key_s != prev)
+    # no flush-time t-based re-cull: it cost a (lb,)-sized random gather
+    # (~40 ms/flush, the single most expensive op of the round-3 design)
+    # and pruned nothing the chunk loop's per-slot t_b bound would not
+    # reject anyway
+    live = (idx < s.n_lf) & (s.lf_tid[:lb_v] >= 0)
+    if packed_key:
+        key = jnp.where(
+            live, (s.lf_tid[:lb_v] << rb) + ray_c, jnp.int32(C) << rb
+        )
+        (key_s,) = jax.lax.sort([key], num_keys=1)
+        tid_s = key_s >> rb
+        rid_s = key_s & ((1 << rb) - 1)
+    else:
+        key = jnp.where(live, s.lf_tid[:lb_v], C)
+        tid_s, rid_s = jax.lax.sort([key, ray_c], num_keys=1)
+    valid_s = tid_s < C
+    prev = jnp.concatenate([jnp.full((1,), -1, tid_s.dtype), tid_s[:-1]])
+    newrun = valid_s & (tid_s != prev)
     # block breaks at run starts OR 128-aligned positions: every block
     # stays within one treelet run and spans at most BLOCK pairs, without
     # needing a rank-within-run scan — the in_blk mask in the chunk loop
@@ -280,37 +443,56 @@ def _flush(tp: TreeletPack, featT_tab, oT, dT, s: _SState, lb: int,
     brk = newrun | (valid_s & (idx % BLOCK == 0))
     blk_of = jnp.cumsum(brk.astype(jnp.int32)) - 1  # sorted ascending
     n_blocks = jnp.max(jnp.where(valid_s, blk_of, -1)) + 1
-    # block b's pairs start at the first sorted position with blk_of == b:
-    # a binary search over the monotone blk_of (scatter-free)
-    block_start = jnp.searchsorted(
-        blk_of, jnp.arange(b_cap, dtype=jnp.int32), side="left"
-    ).astype(jnp.int32)
+    # block b's pairs start at the position of the b-th set bit of brk:
+    # one single-array int sort compacts those positions to the front
+    # (searchsorted over the 1.5M-row blk_of was ~100x slower here)
+    (start_sorted,) = jax.lax.sort(
+        [jnp.where(brk, idx, _I32_MAX)], num_keys=1
+    )
+    block_start = start_sorted[:b_cap]
 
     def chunk_cond(c):
         return c[0] < n_blocks
 
     def chunk_body(c):
-        cstart, t, prim, n_tl = c
+        cstart, rayE, rayF, prim, n_tl = c
         bids = cstart + jnp.arange(chunk, dtype=jnp.int32)  # (CH,)
         # gather (not dynamic_slice): a slice's clamped start would
         # misalign starts against bids on the last chunk when n_blocks
         # approaches b_cap, silently dropping or misbinding trailing blocks
         starts = block_start[jnp.minimum(bids, b_cap - 1)]
-        pos = jnp.minimum(starts[:, None] + jnp.arange(BLOCK), lb_v - 1)
-        in_blk = blk_of[pos] == bids[:, None]  # masks run ends + overflow
-        rows = jnp.where(in_blk, rid_s[pos], -1)  # (CH, BLOCK) ray ids
-        tids = jnp.where(bids < n_blocks, key_s[jnp.minimum(starts, lb_v - 1)], 0)
+        # the slice window is clamped to stay in bounds (slots outside
+        # the block are masked by in_blk), but the treelet id MUST be
+        # read at the true start: a block beginning within BLOCK of the
+        # buffer end would otherwise bind to the preceding run's treelet
+        starts_w = jnp.minimum(starts, lb_v - BLOCK)
+        # each block's slots are a CONTIGUOUS 128-run of the sorted
+        # buffer: fetch them as sliced-row gathers (batched row copies)
+        # — a flat gather of the same 65k positions costs ~21 ns/INDEX
+        # (2 x 1.4 ms per chunk, profiled)
+        blk_row = _slice_rows(blk_of, starts_w, BLOCK)  # (CH, BLOCK)
+        rid_row = _slice_rows(rid_s, starts_w, BLOCK)  # (CH, BLOCK)
+        in_blk = blk_row == bids[:, None]  # masks run ends + overflow
+        rows = jnp.where(in_blk, rid_row, -1)  # (CH, BLOCK) ray ids
+        tids = jnp.where(
+            bids < n_blocks, tid_s[jnp.minimum(starts, lb_v - 1)], 0
+        )
         tids = jnp.clip(tids, 0, C - 1)
         has_ray = rows >= 0
         rid = jnp.where(has_ray, rows, 0)
-        t_b = jnp.where(has_ray, t[rid], -jnp.inf)  # dead slots: t<tm fails
         ctr = tp.center[tids]  # (CH, 3)
         off = tp.offset[tids]  # (CH,)
-        # component-wise ray fetch + TRANSPOSED feature build: phi rows on
-        # axis 1, the 128 rays on lanes — (CH, BLOCK, 16) would put 16 on
-        # lanes (the profiled layout sin of the old path)
-        oc = [jnp.take(oT[i], rid) - ctr[:, i][:, None] for i in range(3)]
-        dc = [jnp.take(dT[i], rid) for i in range(3)]
+        # ONE lane-axis take covers o, d AND t (see rayE/rayF note),
+        # then a TRANSPOSED feature build: phi rows on axis 1, the 128
+        # rays on lanes — (CH, BLOCK, 16) would put 16 on lanes (the
+        # profiled layout sin of the old path)
+        rr = jnp.take(rayF, rid.reshape(-1), axis=1)  # (8, CH*BLOCK)
+        rrows = jnp.swapaxes(
+            rr.reshape(8, chunk, BLOCK), 0, 1
+        )  # (CH, 8, BLOCK)
+        t_b = jnp.where(has_ray, rrows[:, 6], -jnp.inf)  # dead: t<tm fails
+        oc = [rrows[:, i] - ctr[:, i][:, None] for i in range(3)]
+        dc = [rrows[:, 3 + i] for i in range(3)]
         phiT = jnp.stack(
             [oc[i] * dc[j] for i in range(3) for j in range(3)]
             + dc + oc + [jnp.ones_like(oc[0])],
@@ -336,67 +518,71 @@ def _flush(tp: TreeletPack, featT_tab, oT, dT, s: _SState, lb: int,
             )
             t_loc, k_loc, _, _ = decode_outputs(out, L, t_b)
         won = has_ray & jnp.isfinite(t_loc)  # t_loc < t[ray] by decode
-        flat_rid = jnp.where(won, rid, R).reshape(-1)
-        t2 = t.at[flat_rid].min(t_loc.reshape(-1), mode="drop")
-        # equality-select second pass: pairs matching the post-min value
-        # write the payload (ties pick an arbitrary winner, as in any
-        # closest-hit tie)
-        win2 = won & (t_loc == t2[rid])
-        sel = jnp.where(win2, rid, R).reshape(-1)
-        prim2 = prim.at[sel].set(
-            (off[:, None] + k_loc.astype(jnp.int32)).reshape(-1), mode="drop"
+        rayE2, rayF2, prim2 = _merge_chunk(
+            rayE, rayF, prim, rid, t_loc, k_loc, off, won, R
         )
         return (
-            cstart + chunk, t2, prim2,
+            cstart + chunk, rayE2, rayF2, prim2,
             n_tl + jnp.sum(has_ray, dtype=jnp.int32),
         )
 
-    init = (jnp.int32(0), s.t, s.prim, s.n_tl)
-    _, t, prim, n_tl = jax.lax.while_loop(chunk_cond, chunk_body, init)
+    init = (jnp.int32(0), s.rayE, s.rayF, s.prim, s.n_tl)
+    _, rayE, rayF, prim, n_tl = jax.lax.while_loop(
+        chunk_cond, chunk_body, init
+    )
     return s._replace(
-        t=t, prim=prim,
+        rayE=rayE, rayF=rayF, prim=prim,
         n_lf=jnp.int32(0), n_tl=n_tl, iters=s.iters + 1,
     )
 
 
 def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool) -> _SState:
     R = o.shape[0]
+    rb = _ray_bits(R)
+    tb = _tn_bits(R)
     slab, w, lb = _sizes(R)
     s8 = 8 * slab
     inv_d = 1.0 / d
-    # lane-major tables, transposed ONCE per wave (see _expand's layout
-    # note): gathers index the LAST axis so their outputs keep the big
-    # dimension on TPU lanes
-    o_invT = jnp.concatenate([o, inv_d], axis=-1).T  # (6, R)
     boxT = jnp.transpose(
         jnp.concatenate([tp.top.child_bmin, tp.top.child_bmax], axis=-1),
         (2, 1, 0),
     )  # (6, 8, N)
     cidT = tp.top.child_idx.T  # (8, N)
+    use_onehot = _use_onehot(int(boxT.shape[2]))
+    tab64 = _node_table(boxT, cidT) if use_onehot else None
     featT_tab = tp.featT  # (C, 16, 4L), stored at build
-    oT = o.T  # (3, R)
-    dT = d.T
 
+    t_max = jnp.asarray(t_max, jnp.float32)
+    # the consolidated lane-major per-ray tables (see _SState.rayE/rayF)
+    pad1 = jnp.zeros((1, R), jnp.float32)
+    rayE = jnp.concatenate([o.T, inv_d.T, t_max[None, :], pad1], axis=0)
+    rayF = jnp.concatenate([o.T, d.T, t_max[None, :], pad1], axis=0)
+    alive0 = t_max > 0.0
     rid0 = jnp.arange(R, dtype=jnp.int32)
-    tn0 = _bits(jnp.where(t_max > 0.0, 0.0, jnp.inf).astype(jnp.float32))
+    # seed: one root pair per LIVE ray, packed exactly like _expand's
+    # interior keys (tn = 0 -> qtn complement = max). Dead lanes sort to
+    # the back and are excluded from n_stk — a mostly-dead bounce wave
+    # pops only its live rays.
+    key0 = jnp.where(
+        alive0, (1 << 30) + (rid0 << tb) + ((1 << tb) - 1), _I32_MAX
+    )
+    (key0_s,) = jax.lax.sort([key0], num_keys=1)
+    n_live = jnp.sum(alive0, dtype=jnp.int32)
     init = _SState(
-        t=jnp.asarray(t_max, jnp.float32),
+        rayE=rayE,
+        rayF=rayF,
         prim=jnp.full((R,), -1, jnp.int32),
-        stk_node=jnp.zeros((w + s8,), jnp.int32),  # [0:R] = root
-        stk_ray=jnp.zeros((w + s8,), jnp.int32).at[:R].set(rid0),
-        stk_tn=jnp.full((w + s8,), _bits(jnp.float32(jnp.inf)), jnp.int32)
-        .at[:R]
-        .set(tn0),
-        n_stk=jnp.int32(R),
-        lf_tid=jnp.full((lb + s8,), -1, jnp.int32),
+        stk_key=jnp.full((w + s8,), _I32_MAX, jnp.int32).at[:R].set(key0_s),
+        stk_code=jnp.zeros((w + s8,), jnp.int32),  # root everywhere
+        n_stk=n_live,
         lf_ray=jnp.zeros((lb + s8,), jnp.int32),
-        lf_tn=jnp.zeros((lb + s8,), jnp.int32),
+        lf_tid=jnp.full((lb + s8,), -1, jnp.int32),
         n_lf=jnp.int32(0),
         n_drop=jnp.int32(0), n_exp=jnp.int32(0), n_tl=jnp.int32(0),
         iters=jnp.int32(0),
     )
 
-    dead = jnp.asarray(t_max, jnp.float32) <= 0.0
+    dead = t_max <= 0.0
 
     def cond(s: _SState):
         go = ((s.n_stk > 0) | (s.n_lf > 0)) & (s.iters < _MAX_ITERS)
@@ -409,8 +595,9 @@ def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool) -> _SState:
         do_flush = (s.n_lf > lb - s8) | (s.n_stk == 0)
         return jax.lax.cond(
             do_flush,
-            lambda ss: _flush(tp, featT_tab, oT, dT, ss, lb, any_hit),
-            lambda ss: _expand(tp, boxT, cidT, o_invT, ss, slab, w, lb, any_hit),
+            lambda ss: _flush(tp, featT_tab, ss, lb, any_hit),
+            lambda ss: _expand(tp, tab64, boxT, cidT, ss, slab, w,
+                               lb, any_hit, use_onehot),
             s,
         )
 
@@ -429,7 +616,7 @@ def stream_intersect(tp: TreeletPack, tri_verts, o, d, t_max) -> Hit:
     t_max = jnp.broadcast_to(jnp.asarray(t_max, jnp.float32), o.shape[:-1])
     s = _traverse(tp, o, d, t_max, False)
     hit = s.prim >= 0
-    t = jnp.where(hit, s.t, jnp.inf)
+    t = jnp.where(hit, s.rayF[6], jnp.inf)
     tv = tri_verts[jnp.maximum(s.prim, 0)]  # (R, 3, 3)
     v0, v1, v2 = tv[:, 0], tv[:, 1], tv[:, 2]
     e1 = v1 - v0

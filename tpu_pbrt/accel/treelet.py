@@ -44,24 +44,24 @@ class TreeletPack(NamedTuple):
     (16, 4T) weights: rows are output columns, so a leaf block feeds the
     MXU as dot(featT (4L,16), phiT (16,128)) with the 128 rays on the lane
     dimension — the shape the Pallas leaf kernel (accel/leafkernel.py)
-    consumes without a transpose."""
+    consumes without a transpose. Only this one layout is stored: it is
+    the scene's largest array (~0.5 GB for crown-class), so keeping a
+    second transposed copy for the packet walker would double device
+    residency; the packet walker transposes per-leaf instead."""
 
     top: WideBVH  # 8-wide top tree; leaf codes encode treelet ids
-    feat: jnp.ndarray  # (C, 4*LEAF_TRIS, 16) f32 MT feature matrices
-    featT: jnp.ndarray  # (C, 16, 4*LEAF_TRIS): the stream tracer's layout
-    # (stored at build — transposing per wave would copy the scene's
-    # largest array, ~1 GB for crown-class, every traversal call)
+    featT: jnp.ndarray  # (C, 16, 4*LEAF_TRIS) f32 MT feature matrices
     center: jnp.ndarray  # (C, 3) f32 re-centering point per treelet
     offset: jnp.ndarray  # (C,) i32 first leaf-order triangle id
     count: jnp.ndarray  # (C,) i32 triangles in treelet
 
     @property
     def leaf_tris(self) -> int:
-        return self.feat.shape[1] // 4
+        return self.featT.shape[2] // 4
 
     @property
     def n_treelets(self) -> int:
-        return self.feat.shape[0]
+        return self.featT.shape[0]
 
 
 def _subtree_ranges(bvh: BVHArrays):
@@ -164,7 +164,6 @@ def build_treelet_pack(
 
     return TreeletPack(
         top=top,
-        feat=jnp.asarray(feat),
         featT=jnp.asarray(np.ascontiguousarray(feat.transpose(0, 2, 1))),
         center=jnp.asarray(center),
         offset=jnp.asarray(off, jnp.int32),

@@ -204,7 +204,11 @@ class SPPMIntegrator(WavefrontIntegrator):
                 depth,
                 salt_extra=_SALT_CAM + 500,
                 vis_segments=self.vis_segments,
-                sampler=(self.skind, self.spp),
+                # the sample index here is it_idx in [0, n_iterations), NOT
+                # a [0, spp) sampler index: the stratification domain must
+                # cover the iteration count or later iterations replay the
+                # same permuted NEE samples and direct light never converges
+                sampler=(self.skind, self.n_iterations),
             )
             nrays = nrays + 2 * jnp.sum(found.astype(jnp.int32))
             has_diffuse, has_glossy, is_spec = bxdf._lobe_flags(mp)

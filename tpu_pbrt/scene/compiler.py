@@ -550,11 +550,11 @@ def lower_materials(mat_records: List, tex_registry) -> Dict[str, np.ndarray]:
             fold_spec(rec, "Kt", 1.0, "kt", None, i)
             fold_f(rec, "eta", 1.5, "eta", None, i)
             # glass.cpp: nonzero uroughness/vroughness selects the
-            # microfacet reflection/transmission lobes (rough glass)
+            # microfacet reflection/transmission lobes (rough glass).
+            # vroughness defaults to 0 INDEPENDENTLY of uroughness (a
+            # scene giving only uroughness is anisotropic under pbrt)
             fold_f(rec, "uroughness", 0.0, "rough_u", "rough_tex", i)
             fold_f(rec, "vroughness", 0.0, "rough_v", None, i)
-            if p.get("vroughness") is None:
-                tab["rough_v"][i] = tab["rough_u"][i]
             tab["remap"][i] = int(p.get("remaproughness", True))
             tab["eta"][i] = tab["eta"][i][:1].repeat(3)
         elif t == "mirror":
