@@ -140,3 +140,42 @@ class TestImageIO:
     def test_gamma_correct_inverse(self):
         v = np.linspace(0, 1, 64)
         assert np.allclose(imageio.inverse_gamma_correct(imageio.gamma_correct(v)), v, atol=1e-6)
+
+
+class TestAlignedAccumulation:
+    def test_aligned_matches_scatter_path(self):
+        # the aligned (scatter-free) fast path must reproduce the general
+        # add_samples bit pattern for pixel-major whole-pixel chunks
+        rng = np.random.default_rng(7)
+        film = Film(resolution=(8, 4), filt=FilterSpec("box", 0.5, 0.5, 0, 0), filename="")
+        spp = 4
+        npc = film.aligned_chunk_pixels(8 * spp, spp)
+        assert npc == 8
+        state_a = film.init_state()
+        state_b = film.init_state()
+        for c in range(4):  # 4 chunks of 8 pixels x 4 spp tile the 32 px
+            start_pix = c * 8
+            k = np.arange(8 * spp)
+            pix = start_pix + k // spp
+            px = pix % 8
+            py = pix // 8
+            jit = rng.random((8 * spp, 2)).astype(np.float32)
+            p_film = np.stack([px + jit[:, 0], py + jit[:, 1]], -1)
+            L = rng.random((8 * spp, 3)).astype(np.float32)
+            wt = rng.random(8 * spp).astype(np.float32)
+            state_a = film.add_samples(state_a, jnp.asarray(p_film), jnp.asarray(L), jnp.asarray(wt))
+            state_b = film.add_samples_aligned(
+                state_b, jnp.int32(start_pix), spp, jnp.asarray(p_film), jnp.asarray(L), jnp.asarray(wt)
+            )
+        np.testing.assert_allclose(np.asarray(state_a.rgb), np.asarray(state_b.rgb), rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(state_a.weight), np.asarray(state_b.weight), rtol=1e-6, atol=1e-7)
+
+    def test_aligned_gate_rejects_wide_filters_and_crops(self):
+        wide = Film(resolution=(8, 4), filt=FilterSpec("gaussian", 2.0, 2.0, 2.0, 0), filename="")
+        assert wide.aligned_chunk_pixels(32, 4) == 0
+        crop = Film(resolution=(8, 4), filt=FilterSpec("box", 0.5, 0.5, 0, 0), filename="",
+                    crop_window=(0.25, 0.75, 0.0, 1.0))
+        assert crop.aligned_chunk_pixels(32, 4) == 0
+        box = Film(resolution=(8, 4), filt=FilterSpec("box", 0.5, 0.5, 0, 0), filename="")
+        assert box.aligned_chunk_pixels(30, 4) == 0  # not whole-pixel
+        assert box.aligned_chunk_pixels(12, 4) == 0  # 3 px doesn't tile 32

@@ -381,9 +381,7 @@ def bench_wave():
             # jitter origins so every wave differs (anti-memoization)
             oo = o + jnp.float32(1e-4) * (i + 1)
             h = stream_intersect(tp, dev["tri_verts"], oo, d, jnp.inf)
-            return acc + jnp.sum(h.t[jnp.isfinite(h.t)].astype(jnp.float64)
-                                 if False else jnp.where(
-                                     jnp.isfinite(h.t), h.t, 0.0))
+            return acc + jnp.sum(jnp.where(jnp.isfinite(h.t), h.t, 0.0))
         return jax.lax.fori_loop(0, reps, body, jnp.float32(0))
 
     float(run(o, d, 1))

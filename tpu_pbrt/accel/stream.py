@@ -110,8 +110,10 @@ CHUNK = 512
 #: safety bound on while_loop iterations (real waves take tens to hundreds)
 _MAX_ITERS = 1 << 16
 #: above this top-node count the one-hot box matmul's N dimension costs
-#: more than the native gather it replaces
-_ONEHOT_MAX_NODES = 4096
+#: more than the native gather it replaces — and its materialized (N, S)
+#: one-hot operand (N * 131072 * 4 bytes per EXPAND) starts to threaten
+#: HBM. 512 is the largest measured-good size (~268 MB operand).
+_ONEHOT_MAX_NODES = 512
 
 _I32_MAX = np.int32(2**31 - 1)
 
@@ -421,8 +423,11 @@ def _flush(tp: TreeletPack, featT_tab, s: _SState, lb: int,
     # no flush-time t-based re-cull: it cost a (lb,)-sized random gather
     # (~40 ms/flush, the single most expensive op of the round-3 design)
     # and pruned nothing the chunk loop's per-slot t_b bound would not
-    # reject anyway
+    # reject anyway. Shadow waves still prune pairs whose ray has its
+    # occlusion answer (one i32 gather; those pairs are pure waste).
     live = (idx < s.n_lf) & (s.lf_tid[:lb_v] >= 0)
+    if any_hit:
+        live = live & (s.prim[ray_c] < 0)
     if packed_key:
         key = jnp.where(
             live, (s.lf_tid[:lb_v] << rb) + ray_c, jnp.int32(C) << rb
@@ -604,20 +609,21 @@ def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool) -> _SState:
     return jax.lax.while_loop(cond, body, init)
 
 
-@jax.jit
-def stream_intersect(tp: TreeletPack, tri_verts, o, d, t_max) -> Hit:
-    """Closest hit (or first-hit source for the any-hit predicate) for a
-    flat ray batch. o, d: (R, 3); t_max scalar or (R,); tri_verts the
-    shared leaf-order (T, 3, 3) vertex array the winner's barycentrics are
-    recomputed from (ONE row fetch per ray beats scattering b0/b1 per
-    tested block slot during the merge). Returns Hit with global
-    leaf-order triangle ids — API-compatible with bvh_intersect /
-    wide_intersect / packet_intersect."""
-    t_max = jnp.broadcast_to(jnp.asarray(t_max, jnp.float32), o.shape[:-1])
-    s = _traverse(tp, o, d, t_max, False)
-    hit = s.prim >= 0
-    t = jnp.where(hit, s.rayF[6], jnp.inf)
-    tv = tri_verts[jnp.maximum(s.prim, 0)]  # (R, 3, 3)
+def _finalize_hits(tri_verts, o, d, t_raw, prim) -> Hit:
+    """(t, prim) -> full Hit: ONE tri_verts row fetch per ray recovers
+    the winner's barycentrics (beats scattering b0/b1 per tested block
+    slot during the merge), and the fetched vertices ride along in
+    Hit.tv so shading never re-gathers them."""
+    hit = prim >= 0
+    t = jnp.where(hit, t_raw, jnp.inf)
+    # take from a lane-major (9, T) view: the native (T, 3, 3) layout
+    # gathers at ~33 ns per fetched element on this v5e, a lane-major
+    # axis-1 take at ~2.6 (the reshape+transpose copies once per wave)
+    T = tri_verts.shape[0]
+    tv9T = tri_verts.reshape(T, 9).T  # (9, T)
+    tv = jnp.take(tv9T, jnp.maximum(prim, 0), axis=1).T.reshape(
+        -1, 3, 3
+    )  # (R, 3, 3)
     v0, v1, v2 = tv[:, 0], tv[:, 1], tv[:, 2]
     e1 = v1 - v0
     e2 = v2 - v0
@@ -630,7 +636,34 @@ def stream_intersect(tp: TreeletPack, tri_verts, o, d, t_max) -> Hit:
     v = jnp.sum(d * qvec, axis=-1) * inv
     b0 = jnp.where(hit, 1.0 - u - v, 0.0)
     b1 = jnp.where(hit, u, 0.0)
-    return Hit(t, s.prim, b0, b1)
+    return Hit(t, prim, b0, b1, tv)
+
+
+@jax.jit
+def stream_intersect(tp: TreeletPack, tri_verts, o, d, t_max) -> Hit:
+    """Closest hit for a flat ray batch. o, d: (R, 3); t_max scalar or
+    (R,). Returns Hit with global leaf-order triangle ids (and the hit
+    vertices in Hit.tv) — API-compatible with bvh_intersect /
+    wide_intersect / packet_intersect."""
+    t_max = jnp.broadcast_to(jnp.asarray(t_max, jnp.float32), o.shape[:-1])
+    s = _traverse(tp, o, d, t_max, False)
+    return _finalize_hits(tri_verts, o, d, s.rayF[6], s.prim)
+
+
+@partial(jax.jit, static_argnames=("n_finalize",))
+def stream_intersect_split(tp: TreeletPack, tri_verts, o, d, t_max,
+                           n_finalize: int):
+    """Fused-wave closest hit: traverse ALL rays, but build the full Hit
+    (barycentric refetch) only for the first n_finalize — the tail (the
+    integrator's queued shadow rays) needs just prim>=0, and skipping
+    its per-ray tri_verts row fetch saves ~9 gathered elements/ray."""
+    t_max = jnp.broadcast_to(jnp.asarray(t_max, jnp.float32), o.shape[:-1])
+    s = _traverse(tp, o, d, t_max, False)
+    n = n_finalize
+    hit = _finalize_hits(
+        tri_verts, o[:n], d[:n], s.rayF[6][:n], s.prim[:n]
+    )
+    return hit, s.prim[n:]
 
 
 def stream_intersect_p(tp: TreeletPack, o, d, t_max):

@@ -41,12 +41,16 @@ MAX_RAYS_PER_DISPATCH = 1 << 18
 
 class Hit(NamedTuple):
     """SoA hit record; prim == -1 means miss. b0/b1 are barycentrics of
-    vertices 0/1 (b2 = 1-b0-b1)."""
+    vertices 0/1 (b2 = 1-b0-b1). tv optionally carries the hit
+    triangle's (…, 3, 3) vertices when the tracer already fetched them —
+    per-element gather costs dominate on TPU, so consumers
+    (make_interaction) reuse this instead of re-gathering tri_verts."""
 
     t: jnp.ndarray
     prim: jnp.ndarray
     b0: jnp.ndarray
     b1: jnp.ndarray
+    tv: jnp.ndarray | None = None
 
 
 def intersect_triangle(o, d, p0, p1, p2, t_max):

@@ -284,23 +284,25 @@ class MatParams(NamedTuple):
 
 
 def gather_mat(mat: dict, mid) -> MatParams:
-    remap = mat["remap"][mid]
-    ru = mat["rough_u"][mid]
-    rv = mat["rough_v"][mid]
+    from tpu_pbrt.core.smalltab import small_take
+
+    remap = small_take(mat["remap"], mid)
+    ru = small_take(mat["rough_u"], mid)
+    rv = small_take(mat["rough_v"], mid)
     ax = jnp.where(remap > 0, tr_roughness_to_alpha(ru), jnp.maximum(ru, 1e-3))
     ay = jnp.where(remap > 0, tr_roughness_to_alpha(rv), jnp.maximum(rv, 1e-3))
     return MatParams(
-        mtype=mat["type"][mid],
-        kd=mat["kd"][mid],
-        ks=mat["ks"][mid],
-        kr=mat["kr"][mid],
-        kt=mat["kt"][mid],
-        eta=mat["eta"][mid],
-        k=mat["k"][mid],
+        mtype=small_take(mat["type"], mid),
+        kd=small_take(mat["kd"], mid),
+        ks=small_take(mat["ks"], mid),
+        kr=small_take(mat["kr"], mid),
+        kt=small_take(mat["kt"], mid),
+        eta=small_take(mat["eta"], mid),
+        k=small_take(mat["k"], mid),
         ax=ax,
         ay=ay,
-        sigma=mat["sigma"][mid],
-        opacity=mat["opacity"][mid],
+        sigma=small_take(mat["sigma"], mid),
+        opacity=small_take(mat["opacity"], mid),
         # glass.cpp activates the microfacet lobes when EITHER axis is
         # rough (urough != 0 || vrough != 0)
         rough_raw=jnp.maximum(ru, rv),

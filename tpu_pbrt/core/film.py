@@ -20,6 +20,7 @@ from __future__ import annotations
 import math
 from typing import NamedTuple, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -146,6 +147,73 @@ class Film:
                 rgb = rgb.at[pyc, pxc].add(fw[..., None] * L)
                 wsum = wsum.at[pyc, pxc].add(fw)
         return FilmState(rgb, wsum, state.splat)
+
+    def aligned_chunk_pixels(self, chunk: int, spp: int) -> int:
+        """Static gate for add_samples_aligned: returns the pixels per
+        chunk when the fast path applies (the default box(0.5) filter —
+        a one-pixel deposit — full-frame crop, whole-pixel chunks tiling
+        the frame exactly), else 0."""
+        f = self.filter
+        box_half = f.name == "box" and f.xwidth == 0.5 and f.ywidth == 0.5
+        rx, ry = self.full_resolution
+        cx0, cx1, cy0, cy1 = self.cropped_pixel_bounds
+        full = (cx0, cx1, cy0, cy1) == (0, rx, 0, ry)
+        if not box_half or not full or spp <= 0 or chunk % spp:
+            return 0
+        npc = chunk // spp
+        return npc if (rx * ry) % npc == 0 else 0
+
+    def add_samples_aligned(
+        self, state: FilmState, start_pix, spp: int, p_film, L,
+        ray_weight=None,
+    ) -> FilmState:
+        """add_samples for a chunk of `chunk//spp` CONSECUTIVE pixels
+        with spp consecutive samples each (the render loop's layout):
+        the per-pixel filter sums become one reshape + axis-sum and the
+        film update two contiguous slice-adds — no scatter. Scatter-adds
+        of the general path cost ~90 ms per 1M-sample chunk on this
+        v5e; this is ~2 ms. Caller must have checked
+        aligned_chunk_pixels() != 0 (box(0.5) only).
+
+        Documented deviation: a jitter of EXACTLY 0.0 lands on a pixel
+        boundary, where the general path's box filter deposits the
+        sample into BOTH adjacent pixels with weight 1; this path
+        deposits into the sample's own pixel only. The double deposit
+        raises rgb and weight together, so the developed (weighted-mean)
+        image is unchanged up to rounding — and the event has ~2^-23
+        probability per sample."""
+        f = self.filter
+        L = jnp.asarray(L, jnp.float32)
+        bad = jnp.any(jnp.isnan(L) | jnp.isinf(L), axis=-1)
+        L = jnp.where(bad[..., None], 0.0, L)
+        if np.isfinite(self.max_sample_luminance):
+            y = luminance(L)
+            s = jnp.where(
+                y > self.max_sample_luminance,
+                self.max_sample_luminance / jnp.maximum(y, 1e-20), 1.0,
+            )
+            L = L * s[..., None]
+        if ray_weight is not None:
+            L = L * jnp.asarray(ray_weight, jnp.float32)[..., None]
+        del f  # box(0.5): in-pixel weight is identically 1
+        n = L.shape[0]
+        npc = n // spp
+        contrib = L.reshape(npc, spp, 3).sum(axis=1)
+        wadd = jnp.full((npc,), jnp.float32(spp))
+        rx, ry = self.full_resolution
+        rgb_flat = state.rgb.reshape(rx * ry, 3)
+        w_flat = state.weight.reshape(rx * ry)
+        cur = jax.lax.dynamic_slice(rgb_flat, (start_pix, 0), (npc, 3))
+        rgb_flat = jax.lax.dynamic_update_slice(
+            rgb_flat, cur + contrib, (start_pix, 0)
+        )
+        curw = jax.lax.dynamic_slice(w_flat, (start_pix,), (npc,))
+        w_flat = jax.lax.dynamic_update_slice(
+            w_flat, curw + wadd, (start_pix,)
+        )
+        return FilmState(
+            rgb_flat.reshape(ry, rx, 3), w_flat.reshape(ry, rx), state.splat
+        )
 
     def add_splats(self, state: FilmState, p_film, v) -> FilmState:
         """Film::AddSplat over a batch (no filtering; box deposit)."""

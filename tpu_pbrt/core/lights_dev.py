@@ -21,6 +21,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from tpu_pbrt.core.sampling import Distribution2D, uniform_sample_triangle
+from tpu_pbrt.core.smalltab import small_take, small_take_along
 from tpu_pbrt.core.vecmath import dot, normalize
 from tpu_pbrt.scene.compiler import (
     LIGHT_AREA,
@@ -137,8 +138,8 @@ def _light_map_scale(dev, lt, li_idx, w_from_light, is_gonio, is_proj):
     the shared light atlas. Clamp-filtered bilinear lookup with per-row
     traced extents."""
     atlas = dev["light_atlas"]
-    w2l = lt["w2l"][li_idx].reshape(li_idx.shape + (3, 3))
-    img = lt["img"][li_idx]  # (..., 3): offset, width, height
+    w2l = small_take(lt["w2l"], li_idx).reshape(li_idx.shape + (3, 3))
+    img = small_take(lt["img"], li_idx)  # (..., 3): offset, width, height
     off, iw, ih = img[..., 0], img[..., 1], img[..., 2]
     dl = jnp.einsum("...ij,...j->...i", w2l, w_from_light)
     dl = normalize(dl)
@@ -153,8 +154,8 @@ def _light_map_scale(dev, lt, li_idx, w_from_light, is_gonio, is_proj):
     v_g = theta / jnp.pi
 
     # projection: perspective divide into the fov screen window
-    tan_half = lt["cos0"][li_idx]
-    aspect = lt["cos1"][li_idx]
+    tan_half = small_take(lt["cos0"], li_idx)
+    aspect = small_take(lt["cos1"], li_idx)
     z = dl[..., 2]
     inside_z = z > 1e-3
     zs = jnp.where(inside_z, z, 1.0)
@@ -192,15 +193,15 @@ def _light_map_scale(dev, lt, li_idx, w_from_light, is_gonio, is_proj):
 def sample_light_rows(dev, li_idx, ref_p, u1, u2) -> LightSample:
     """Sample_Li for explicit light rows li_idx (R,) — no pick pmf folded."""
     lt = dev["light"]
-    ltype = lt["type"][li_idx]
-    lp = lt["p"][li_idx]
-    lL = lt["L"][li_idx]
-    ldir = lt["dir"][li_idx]
-    cos0 = lt["cos0"][li_idx]
-    cos1 = lt["cos1"][li_idx]
-    tri = lt["tri"][li_idx]
-    twosided = lt["twosided"][li_idx]
-    area = lt["area"][li_idx]
+    ltype = small_take(lt["type"], li_idx)
+    lp = small_take(lt["p"], li_idx)
+    lL = small_take(lt["L"], li_idx)
+    ldir = small_take(lt["dir"], li_idx)
+    cos0 = small_take(lt["cos0"], li_idx)
+    cos1 = small_take(lt["cos1"], li_idx)
+    tri = small_take(lt["tri"], li_idx)
+    twosided = small_take(lt["twosided"], li_idx)
+    area = small_take(lt["area"], li_idx)
     wr = dev["world_radius"]
 
     # -- point / spot -----------------------------------------------------
@@ -218,7 +219,10 @@ def sample_light_rows(dev, li_idx, ref_p, u1, u2) -> LightSample:
     dist_dist = jnp.full_like(dist_pt, 2.0) * wr
 
     # -- area (triangle) --------------------------------------------------
-    tv = dev["tri_verts"][jnp.maximum(tri, 0)]  # (R,3,3)
+    if "tri_v" in lt:
+        tv = small_take(lt["tri_v"], li_idx)  # (R,3,3) dense select
+    else:
+        tv = dev["tri_verts"][jnp.maximum(tri, 0)]
     p_l, n_l = sample_triangle_point(tv, u1, u2)
     to_a = p_l - ref_p
     d2a = jnp.maximum(jnp.sum(to_a * to_a, axis=-1), 1e-12)
@@ -301,17 +305,19 @@ class SpatialLightDistribution(NamedTuple):
         row = self.cdf[self._voxel(p)]  # (..., L)
         idx = jnp.sum((u[..., None] >= row).astype(jnp.int32), axis=-1)
         idx = jnp.minimum(idx, row.shape[-1] - 1)
-        prev = jnp.where(idx > 0, jnp.take_along_axis(row, jnp.maximum(idx - 1, 0)[..., None], -1)[..., 0], 0.0)
-        pmf = jnp.take_along_axis(row, idx[..., None], -1)[..., 0] - prev
+        prev = jnp.where(
+            idx > 0, small_take_along(row, jnp.maximum(idx - 1, 0)), 0.0
+        )
+        pmf = small_take_along(row, idx) - prev
         return idx, jnp.maximum(pmf, 1e-12)
 
     def discrete_pdf_at(self, idx, p):
         row = self.cdf[self._voxel(p)]
         idx = jnp.clip(idx, 0, row.shape[-1] - 1)
-        prev = jnp.where(idx > 0, jnp.take_along_axis(row, jnp.maximum(idx - 1, 0)[..., None], -1)[..., 0], 0.0)
-        return jnp.maximum(
-            jnp.take_along_axis(row, idx[..., None], -1)[..., 0] - prev, 1e-12
+        prev = jnp.where(
+            idx > 0, small_take_along(row, jnp.maximum(idx - 1, 0)), 0.0
         )
+        return jnp.maximum(small_take_along(row, idx) - prev, 1e-12)
 
 
 def sample_one_light(dev, light_distr, ref_p, u_pick, u1, u2) -> LightSample:
@@ -339,7 +345,7 @@ def emitted_pdf(dev, light_distr, ref_p, hit_p, light_idx, n_l):
     area light `light_idx` from ref_p."""
     lt = dev["light"]
     n = lt["type"].shape[0]
-    area = lt["area"][jnp.maximum(light_idx, 0)]
+    area = small_take(lt["area"], jnp.maximum(light_idx, 0))
     to_h = hit_p - ref_p
     d2 = jnp.maximum(jnp.sum(to_h * to_h, axis=-1), 1e-12)
     wi = to_h / jnp.sqrt(d2)[..., None]
@@ -418,18 +424,18 @@ def sample_le(dev, light_distr, u_pick, up1, up2, ud1, ud2) -> LeSample:
         li_idx = jnp.minimum(
             jnp.sum((u_pick[..., None] >= cdf).astype(jnp.int32), -1), n_lights - 1
         )
-        pmf = jnp.maximum(light_distr.mean_pmf[li_idx], 1e-12)
+        pmf = jnp.maximum(small_take(light_distr.mean_pmf, li_idx), 1e-12)
     else:
         li_idx, pmf = light_distr.sample_discrete(u_pick)
-    ltype = lt["type"][li_idx]
-    lp = lt["p"][li_idx]
-    lL = lt["L"][li_idx]
-    ldir = lt["dir"][li_idx]
-    cos0 = lt["cos0"][li_idx]
-    cos1 = lt["cos1"][li_idx]
-    tri = lt["tri"][li_idx]
-    twosided = lt["twosided"][li_idx]
-    area = lt["area"][li_idx]
+    ltype = small_take(lt["type"], li_idx)
+    lp = small_take(lt["p"], li_idx)
+    lL = small_take(lt["L"], li_idx)
+    ldir = small_take(lt["dir"], li_idx)
+    cos0 = small_take(lt["cos0"], li_idx)
+    cos1 = small_take(lt["cos1"], li_idx)
+    tri = small_take(lt["tri"], li_idx)
+    twosided = small_take(lt["twosided"], li_idx)
+    area = small_take(lt["area"], li_idx)
 
     # -- point: uniform sphere -------------------------------------------
     d_pt = uniform_sample_sphere(ud1, ud2)
@@ -448,7 +454,10 @@ def sample_le(dev, light_distr, u_pick, up1, up2, ud1, ud2) -> LeSample:
     # -- area: uniform point on the triangle + cosine hemisphere ---------
     # twosided lights pick the emission side with a remapped ud1 and halve
     # the direction pdf (diffuse.cpp Sample_Le / Pdf_Le)
-    tv = dev["tri_verts"][jnp.maximum(tri, 0)]
+    if "tri_v" in lt:
+        tv = small_take(lt["tri_v"], li_idx)
+    else:
+        tv = dev["tri_verts"][jnp.maximum(tri, 0)]
     p_a, n_front = sample_triangle_point(tv, up1, up2)
     two = twosided > 0
     flip = two & (ud1 >= 0.5)
@@ -533,8 +542,8 @@ def emitted_radiance(dev, tri_light, wo_world, n_g):
     DiffuseAreaLight::L): emits from the front side unless twosided."""
     lt = dev["light"]
     idx = jnp.maximum(tri_light, 0)
-    lL = lt["L"][idx]
-    two = lt["twosided"][idx]
+    lL = small_take(lt["L"], idx)
+    two = small_take(lt["twosided"], idx)
     front = dot(n_g, wo_world) > 0.0
     emit = (tri_light >= 0) & (front | (two > 0))
     return jnp.where(emit[..., None], lL, 0.0)

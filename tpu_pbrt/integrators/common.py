@@ -92,6 +92,21 @@ def scene_intersect(dev, o, d, t_max) -> Hit:
     return bvh_intersect(dev["bvh"], dev["tri_verts"], o, d, t_max)
 
 
+def scene_intersect_fused(dev, o, d, t_max, n_cam: int):
+    """Fused camera+shadow closest-hit: full Hit for the first n_cam
+    rays, bare prim ids for the tail (queued shadow rays only need
+    prim >= 0; skipping their barycentric tri_verts refetch saves ~9
+    gathered elements per shadow ray on the stream path)."""
+    if "tstream" in dev:
+        from tpu_pbrt.accel.stream import stream_intersect_split
+
+        return stream_intersect_split(
+            dev["tstream"], dev["tri_verts"], o, d, t_max, n_cam
+        )
+    hit = scene_intersect(dev, o, d, t_max)
+    return jax.tree.map(lambda a: a[:n_cam], hit), hit.prim[n_cam:]
+
+
 def scene_intersect_p(dev, o, d, t_max):
     """Scene::IntersectP — shadow-ray predicate."""
     if "tstream" in dev:
@@ -232,9 +247,23 @@ def make_interaction(dev, hit: Hit, o, d) -> Interaction:
     """Hit records -> surface interaction (interaction.cpp SurfaceInteraction
     + triangle.cpp's normal/uv interpolation)."""
     prim = jnp.maximum(hit.prim, 0)
-    tv = dev["tri_verts"][prim]
-    tn = dev["tri_normals"][prim]
-    tuv = dev["tri_uvs"][prim]
+    # the tracer already fetched the hit vertices (Hit.tv) — re-gathering
+    # tri_verts costs ~9 gathered elements/ray on TPU
+    tv = hit.tv if hit.tv is not None else dev["tri_verts"][prim]
+    if "tri_sh16" in dev:
+        # one lane-major (16, T) take: normals, uvs, packed ids
+        sh = jnp.take(dev["tri_sh16"], prim, axis=1)  # (16, R)
+        shT = jnp.moveaxis(sh, 0, -1)  # (..., 16)
+        tn = shT[..., 0:9].reshape(shT.shape[:-1] + (3, 3))
+        tuv = shT[..., 9:15].reshape(shT.shape[:-1] + (3, 2))
+        packed = sh[15].astype(jnp.int32)
+        mat_id = packed // 4096
+        light_id = packed % 4096 - 1
+    else:
+        tn = dev["tri_normals"][prim]
+        tuv = dev["tri_uvs"][prim]
+        mat_id = dev["tri_mat"][prim]
+        light_id = dev["tri_light"][prim]
     b0 = hit.b0
     b1 = hit.b1
     b2 = 1.0 - b0 - b1
@@ -256,8 +285,8 @@ def make_interaction(dev, hit: Hit, o, d) -> Interaction:
         ss=ss,
         ts=ts,
         uv=uv,
-        mat=dev["tri_mat"][prim],
-        light=dev["tri_light"][prim],
+        mat=mat_id,
+        light=light_id,
         wo=-d,
         valid=hit.prim >= 0,
     )
@@ -574,10 +603,18 @@ class WavefrontIntegrator:
             jfn = cached[1]
         else:
             if mesh is None:
+                # pixel-major chunks that tile the frame exactly take the
+                # film's scatter-free aligned accumulation path
+                aligned = film.aligned_chunk_pixels(chunk, spp) > 0
 
                 def chunk_fn(state: FilmState, dev, start_pix, start_s):
                     p_film, L, wt, nrays, splats = body(dev, start_pix, start_s, chunk)
-                    state = film.add_samples(state, p_film, L, wt)
+                    if aligned:
+                        state = film.add_samples_aligned(
+                            state, start_pix, spp, p_film, L, wt
+                        )
+                    else:
+                        state = film.add_samples(state, p_film, L, wt)
                     if splats is not None:
                         state = film.add_splats(state, *splats)
                     return state, nrays

@@ -31,6 +31,7 @@ from tpu_pbrt.core.sampling import power_heuristic, uniform_float
 from tpu_pbrt.core.vecmath import dot, normalize, offset_ray_origin, to_local, to_world
 from tpu_pbrt.integrators.common import (
     scene_intersect,
+    scene_intersect_fused,
     scene_intersect_p,
     unoccluded_tr,
     DIM_BSDF_LOBE,
@@ -111,15 +112,14 @@ class PathIntegrator(WavefrontIntegrator):
             # immediately, so they cost one loop iteration, not a walk
             t_max = jnp.where(alive, jnp.inf, -1.0)
             if fused:
-                hit2 = scene_intersect(
+                R = o.shape[0]
+                hit, sh_prim = scene_intersect_fused(
                     dev,
                     jnp.concatenate([o, st.sh_o]),
                     jnp.concatenate([d, st.sh_d]),
                     jnp.concatenate([t_max, st.sh_dist]),
+                    n_cam=R,
                 )
-                R = o.shape[0]
-                hit = jax.tree.map(lambda a: a[:R], hit2)
-                sh_prim = hit2.prim[R:]
                 # settle the previous bounce's NEE with its visibility
                 vis_prev = (st.sh_dist > 0.0) & (sh_prim < 0)
                 L = L + jnp.where(vis_prev[..., None], st.ld_pend, 0.0)
