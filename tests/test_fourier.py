@@ -1,0 +1,160 @@
+"""FourierBSDF tests: synthetic-table eval against the analytic
+Lambertian it encodes, binary .bsdf round-trip, sampling consistency,
+and an end-to-end scene."""
+
+import os
+import struct
+import tempfile
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_pbrt.core import fourierbsdf as fb
+
+
+def _lambert_table(n_mu=32, rho=0.7):
+    """Table encoding f = rho/pi for reflection: stored a0 = f * |muI|
+    on pairs with muI * muO < 0 (pbrt's muI = cos(-wi) convention)."""
+    mu = np.linspace(-1.0, 1.0, n_mu).astype(np.float32)
+    vals = np.zeros((n_mu, n_mu), np.float32)
+    for o in range(n_mu):
+        for i in range(n_mu):
+            if mu[i] * mu[o] < 0:
+                vals[o, i] = rho / np.pi * abs(mu[i])
+    return fb.make_table(mu, vals), rho
+
+
+def _dirs(n, seed, up=None):
+    rng = np.random.default_rng(seed)
+    d = rng.normal(size=(n, 3))
+    d /= np.linalg.norm(d, axis=-1, keepdims=True)
+    if up is not None:
+        d[:, 2] = np.abs(d[:, 2]) * (1 if up else -1)
+    return jnp.asarray(d, jnp.float32)
+
+
+def test_lambertian_table_eval():
+    tab, rho = _lambert_table()
+    n = 20_000
+    wo = _dirs(n, 1, up=True)
+    wi = _dirs(n, 2, up=True)  # reflection: same hemisphere
+    f, _ = fb.fourier_f_pdf(tab, wo, wi)
+    # away from grazing, eval must reproduce rho/pi
+    mask = (np.asarray(wi[:, 2]) > 0.2) & (np.asarray(wo[:, 2]) > 0.2)
+    got = np.asarray(f[:, 0])[mask]
+    np.testing.assert_allclose(got, rho / np.pi, rtol=0.03)
+    # no transmission encoded: opposite hemisphere is (near) zero away
+    # from the mu = 0 kink, where the Catmull-Rom support necessarily
+    # straddles both signs
+    wi_t = _dirs(n, 3, up=False)
+    f_t, _ = fb.fourier_f_pdf(tab, wo, wi_t)
+    mask_t = (np.asarray(wi_t[:, 2]) < -0.2) & (np.asarray(wo[:, 2]) > 0.2)
+    assert float(np.abs(np.asarray(f_t[:, 0])[mask_t]).max()) < 0.02
+
+
+def test_sampling_estimator_matches():
+    tab, rho = _lambert_table()
+    n = 300_000
+    rng = np.random.default_rng(5)
+    wo = jnp.broadcast_to(
+        jnp.asarray([0.1, 0.2, 0.97], jnp.float32)
+        / np.linalg.norm([0.1, 0.2, 0.97]),
+        (n, 3),
+    )
+    u_l = jnp.asarray(rng.uniform(size=n), jnp.float32)
+    u1 = jnp.asarray(rng.uniform(size=n), jnp.float32)
+    u2 = jnp.asarray(rng.uniform(size=n), jnp.float32)
+    wi = fb.fourier_sample_wi(wo, u_l, u1, u2)
+    f, pdf = fb.fourier_f_pdf(tab, wo, wi)
+    est = float(
+        jnp.mean(
+            jnp.where(
+                pdf > 1e-8,
+                f[:, 0] * jnp.abs(wi[:, 2]) / jnp.maximum(pdf, 1e-8),
+                0.0,
+            )
+        )
+    )
+    # hemispherical albedo of the encoded Lambertian = rho
+    assert abs(est - rho) < 0.03, est
+
+
+def _write_bsdf(path, mu, vals, eta=1.0):
+    """Write the SCATFUN v1 binary (reflection.cpp Read layout)."""
+    n = len(mu)
+    a = np.asarray(vals, np.float32).reshape(-1)
+    m = (np.abs(a) > 0).astype(np.int32)
+    offset = np.arange(n * n, dtype=np.int32)
+    cdf = np.zeros((n, n), np.float32)
+    with open(path, "wb") as f:
+        f.write(b"SCATFUN\x01")
+        f.write(struct.pack("<9i", 1, n, n * n, int(m.max()), 1, 1, 0, 0, 0))
+        f.write(struct.pack("<f", eta))
+        f.write(struct.pack("<4i", 0, 0, 0, 0))
+        f.write(np.asarray(mu, np.float32).tobytes())
+        f.write(cdf.tobytes())
+        ol = np.stack([offset, m], axis=1).astype(np.int32)
+        f.write(ol.tobytes())
+        f.write(a.tobytes())
+
+
+def test_binary_roundtrip():
+    n_mu = 16
+    mu = np.linspace(-1, 1, n_mu).astype(np.float32)
+    rng = np.random.default_rng(7)
+    vals = rng.random((n_mu, n_mu)).astype(np.float32)
+    with tempfile.NamedTemporaryFile(suffix=".bsdf", delete=False) as f:
+        path = f.name
+    try:
+        _write_bsdf(path, mu, vals, eta=1.33)
+        tab = fb.read_bsdf_file(path)
+        assert tab.n_channels == 1
+        assert abs(tab.eta - 1.33) < 1e-6
+        np.testing.assert_allclose(np.asarray(tab.mu), mu)
+        np.testing.assert_allclose(np.asarray(tab.a), vals.reshape(-1))
+    finally:
+        os.unlink(path)
+
+
+def test_fourier_scene_end_to_end():
+    import tpu_pbrt
+
+    tab, rho = _lambert_table(16)
+    with tempfile.NamedTemporaryFile(suffix=".bsdf", delete=False) as f:
+        bsdf_path = f.name
+    n_mu = 16
+    mu = np.linspace(-1, 1, n_mu).astype(np.float32)
+    vals = np.zeros((n_mu, n_mu), np.float32)
+    for o in range(n_mu):
+        for i in range(n_mu):
+            if mu[i] * mu[o] < 0:
+                vals[o, i] = 0.6 / np.pi * abs(mu[i])
+    _write_bsdf(bsdf_path, mu, vals)
+    scene = f"""
+Integrator "path" "integer maxdepth" [3]
+Sampler "random" "integer pixelsamples" [4]
+Film "image" "integer xresolution" [24] "integer yresolution" [24]
+LookAt 0 2 5  0 0 0  0 1 0
+Camera "perspective" "float fov" [45]
+WorldBegin
+AttributeBegin
+  AreaLightSource "diffuse" "rgb L" [10 10 10]
+  Shape "trianglemesh" "integer indices" [0 1 2 0 2 3]
+    "point P" [-1 3.9 -1  1 3.9 -1  1 3.9 1  -1 3.9 1]
+AttributeEnd
+Material "fourier" "string bsdffile" ["{bsdf_path}"]
+Shape "trianglemesh" "integer indices" [0 1 2 0 2 3]
+  "point P" [-3 0 -3  3 0 -3  3 0 3  -3 0 3]
+WorldEnd
+"""
+    with tempfile.NamedTemporaryFile("w", suffix=".pbrt", delete=False) as f:
+        f.write(scene)
+        scene_path = f.name
+    try:
+        res = tpu_pbrt.render_file(scene_path)
+        img = np.asarray(res.image)
+        assert np.isfinite(img).all()
+        assert img.max() > 0.0
+    finally:
+        os.unlink(scene_path)
+        os.unlink(bsdf_path)

@@ -268,6 +268,25 @@ def tr_pdf(wo, wh, ax, ay):
 # Material parameter gather
 # -------------------------------------------------------------------------
 
+class DisneyParams(NamedTuple):
+    """Per-lane Disney 2015 parameters (disney.cpp); present only when
+    the scene uses the material (dz field of MatParams is None
+    otherwise, and none of the Disney code is traced)."""
+
+    metallic: jnp.ndarray  # (R,)
+    spectint: jnp.ndarray
+    aniso: jnp.ndarray
+    sheen: jnp.ndarray
+    sheentint: jnp.ndarray
+    clearcoat: jnp.ndarray
+    ccgloss: jnp.ndarray
+    strans: jnp.ndarray
+    flat: jnp.ndarray
+    dtrans: jnp.ndarray
+    thin: jnp.ndarray  # (R,) bool
+    rough: jnp.ndarray  # (R,) raw roughness (disney does NOT remap)
+
+
 class MatParams(NamedTuple):
     mtype: jnp.ndarray  # (R,)
     kd: jnp.ndarray  # (R,3)
@@ -278,9 +297,12 @@ class MatParams(NamedTuple):
     k: jnp.ndarray
     ax: jnp.ndarray  # (R,) GGX alphas (post-remap)
     ay: jnp.ndarray
-    sigma: jnp.ndarray  # oren-nayar sigma (degrees) / disney metallic
+    sigma: jnp.ndarray  # oren-nayar sigma (degrees)
     opacity: jnp.ndarray
     rough_raw: jnp.ndarray  # (R,) raw (pre-remap) roughness; 0 = smooth
+    dz: "DisneyParams | None" = None
+    hz: "HairParams | None" = None
+    fz: "object | None" = None  # FourierTable (core/fourierbsdf.py)
 
 
 def gather_mat(mat: dict, mid) -> MatParams:
@@ -306,6 +328,28 @@ def gather_mat(mat: dict, mid) -> MatParams:
         # glass.cpp activates the microfacet lobes when EITHER axis is
         # rough (urough != 0 || vrough != 0)
         rough_raw=jnp.maximum(ru, rv),
+        dz=DisneyParams(
+            metallic=small_take(mat["d_metallic"], mid),
+            spectint=small_take(mat["d_spectint"], mid),
+            aniso=small_take(mat["d_aniso"], mid),
+            sheen=small_take(mat["d_sheen"], mid),
+            sheentint=small_take(mat["d_sheentint"], mid),
+            clearcoat=small_take(mat["d_clearcoat"], mid),
+            ccgloss=small_take(mat["d_ccgloss"], mid),
+            strans=small_take(mat["d_strans"], mid),
+            flat=small_take(mat["d_flat"], mid),
+            dtrans=small_take(mat["d_dtrans"], mid),
+            thin=small_take(mat["d_thin"], mid) > 0,
+            rough=ru,
+        ) if "d_metallic" in mat else None,
+        hz=HairParams(
+            sigma_a=small_take(mat["h_sigma_a"], mid),
+            beta_m=small_take(mat["h_beta_m"], mid),
+            beta_n=small_take(mat["h_beta_n"], mid),
+            alpha=small_take(mat["h_alpha"], mid),
+            h=jnp.zeros_like(small_take(mat["h_beta_m"], mid)),
+        ) if "h_beta_m" in mat else None,
+        fz=mat.get("_fourier"),
     )
 
 
@@ -526,6 +570,520 @@ def _rough_glass_f_pdf(mp: MatParams, wo, wi):
     return f, pdf
 
 
+
+
+# -------------------------------------------------------------------------
+# Disney 2015 BSDF (materials/disney.cpp: DisneyDiffuse/FakeSS/Retro/
+# Sheen, DisneyMicrofacetDistribution + DisneyFresnel, DisneyClearcoat,
+# MicrofacetTransmission spec-trans, thin LambertianTransmission).
+# Everything here is traced ONLY when the scene contains a disney
+# material (MatParams.dz gating) — other scenes pay zero compile cost.
+# -------------------------------------------------------------------------
+
+def _sw(c):
+    """SchlickWeight: (1-c)^5 clamped."""
+    m = jnp.clip(1.0 - c, 0.0, 1.0)
+    return (m * m) * (m * m) * m
+
+
+def _gtr1_d(cos_h, alpha):
+    a2 = alpha * alpha
+    denom = jnp.pi * jnp.log(a2) * (1.0 + (a2 - 1.0) * cos_h * cos_h)
+    return (a2 - 1.0) / jnp.where(jnp.abs(denom) < 1e-12, 1e-12, denom)
+
+
+def _smith_g_sep(c, alpha):
+    """Separable smith G1 with the clearcoat's fixed-alpha form
+    (disney.cpp smithG_GGX)."""
+    a2 = alpha * alpha
+    c2 = c * c
+    return 1.0 / (c + jnp.sqrt(jnp.maximum(a2 + c2 - a2 * c2, 1e-12)))
+
+
+def _disney_weights(mp: MatParams):
+    """Shared per-lane derived quantities."""
+    from tpu_pbrt.core.spectrum import luminance
+
+    dz = mp.dz
+    c = mp.kd
+    e = mp.eta[..., 0]
+    metallic = dz.metallic
+    strans = dz.strans
+    dw = (1.0 - metallic) * (1.0 - strans)
+    dt = dz.dtrans * 0.5
+    lum = luminance(c)
+    ctint = jnp.where((lum > 0.0)[..., None], c / jnp.maximum(lum, 1e-12)[..., None], 1.0)
+    csheen = (1.0 - dz.sheentint)[..., None] + dz.sheentint[..., None] * ctint
+    r0 = ((e - 1.0) / (e + 1.0)) ** 2
+    cspec0 = (
+        (1.0 - metallic)[..., None]
+        * r0[..., None]
+        * ((1.0 - dz.spectint)[..., None] + dz.spectint[..., None] * ctint)
+        + metallic[..., None] * c
+    )
+    aspect = jnp.sqrt(jnp.maximum(1.0 - 0.9 * dz.aniso, 1e-6))
+    r2 = dz.rough * dz.rough
+    ax = jnp.maximum(1e-3, r2 / aspect)
+    ay = jnp.maximum(1e-3, r2 * aspect)
+    rscaled = (0.65 * e - 0.35) * dz.rough
+    rs2 = rscaled * rscaled
+    axt = jnp.where(dz.thin, jnp.maximum(1e-3, rs2 / aspect), ax)
+    ayt = jnp.where(dz.thin, jnp.maximum(1e-3, rs2 * aspect), ay)
+    gloss = 0.1 * (1.0 - dz.ccgloss) + 0.001 * dz.ccgloss
+    return c, e, dw, dt, csheen, cspec0, ax, ay, axt, ayt, gloss
+
+
+def _disney_presence(mp: MatParams):
+    dz = mp.dz
+    metallic = dz.metallic
+    dw_pos = (1.0 - metallic) * (1.0 - dz.strans) > 0.0
+    pr = [
+        dw_pos,                      # 0 DisneyDiffuse
+        dw_pos & dz.thin,            # 1 DisneyFakeSS
+        dw_pos,                      # 2 DisneyRetro
+        dw_pos & (dz.sheen > 0.0),   # 3 DisneySheen
+        jnp.ones_like(dw_pos),       # 4 microfacet reflection
+        dz.clearcoat > 0.0,          # 5 clearcoat
+        dz.strans > 0.0,             # 6 microfacet spec transmission
+        dz.thin,                     # 7 LambertianTransmission
+    ]
+    n = sum(p.astype(jnp.int32) for p in pr)
+    return pr, n
+
+
+def _disney_trans_terms(T, e, axt, ayt, wo, wi, wh):
+    """MicrofacetTransmission::f/Pdf with Disney's separable G at an
+    explicit half-vector (etaA=1, etaB=e, radiance transport)."""
+    ci = abs_cos_theta(wi)
+    co = abs_cos_theta(wo)
+    ok = (ci > 1e-7) & (co > 1e-7) & ~same_hemisphere(wo, wi)
+    eta_t = jnp.where(cos_theta(wo) > 0.0, e, 1.0 / jnp.maximum(e, 1e-6))
+    wh_z = jnp.where((wh[..., 2] < 0.0)[..., None], -wh, wh)
+    do_h = jnp.sum(wo * wh_z, axis=-1)
+    di_h = jnp.sum(wi * wh_z, axis=-1)
+    ok = ok & (do_h * di_h < 0.0)
+    d = tr_d(wh_z, axt, ayt)
+    g = tr_g1(wo, axt, ayt) * tr_g1(wi, axt, ayt)
+    F = fresnel_dielectric(do_h, jnp.ones_like(e), e)
+    sqrt_denom = do_h + eta_t * di_h
+    factor = 1.0 / jnp.maximum(eta_t, 1e-6)
+    f = T * jnp.abs(
+        d * g * eta_t * eta_t * (1.0 - F) * jnp.abs(di_h) * jnp.abs(do_h)
+        * factor * factor
+        / jnp.maximum(ci * co * sqrt_denom * sqrt_denom, 1e-12)
+    )[..., None]
+    pdf_wh = tr_pdf(wo, wh_z, axt, ayt)
+    dwh_dwi = jnp.abs(eta_t * eta_t * di_h) / jnp.maximum(
+        sqrt_denom * sqrt_denom, 1e-12
+    )
+    pdf = pdf_wh * dwh_dwi
+    return jnp.where(ok[..., None], f, 0.0), jnp.where(ok, pdf, 0.0), ok
+
+
+def _disney_f_pdf(mp: MatParams, wo, wi):
+    """f and per-lobe-averaged pdf over the full active lobe set
+    (BSDF::f / BSDF::Pdf semantics over the Add()ed lobes)."""
+    dz = mp.dz
+    c, e, dw, dt, csheen, cspec0, ax, ay, axt, ayt, gloss = _disney_weights(mp)
+    pr, n = _disney_presence(mp)
+    refl = same_hemisphere(wo, wi)
+    ci = abs_cos_theta(wi)
+    co = abs_cos_theta(wo)
+    ok_ang = (ci > 1e-7) & (co > 1e-7)
+
+    wh = wi + wo
+    wh_len = jnp.sqrt(jnp.sum(wh * wh, axis=-1))
+    whn = wh / jnp.maximum(wh_len[..., None], 1e-20)
+    cos_d = jnp.sum(wi * whn, axis=-1)  # cosThetaD
+    FL = _sw(ci)
+    FV = _sw(co)
+    rough = dz.rough
+
+    # 0: DisneyDiffuse
+    f0 = (dw * (jnp.where(dz.thin, (1.0 - dz.flat) * (1.0 - dt), 1.0)))[
+        ..., None
+    ] * c * (_INV_PI * (1.0 - 0.5 * FL) * (1.0 - 0.5 * FV))[..., None]
+    # 1: DisneyFakeSS
+    fss90 = cos_d * cos_d * rough
+    fss = (1.0 + (fss90 - 1.0) * FL) * (1.0 + (fss90 - 1.0) * FV)
+    ss = 1.25 * (fss * (1.0 / jnp.maximum(ci + co, 1e-7) - 0.5) + 0.5)
+    f1 = (dw * dz.flat * (1.0 - dt))[..., None] * c * (_INV_PI * ss)[..., None]
+    # 2: DisneyRetro
+    rr = 2.0 * rough * cos_d * cos_d
+    f2 = dw[..., None] * c * (
+        _INV_PI * rr * (FL + FV + FL * FV * (rr - 1.0))
+    )[..., None]
+    # 3: DisneySheen
+    f3 = (dw * dz.sheen)[..., None] * csheen * _sw(cos_d)[..., None]
+    # 4: microfacet reflection (GGX, Disney separable G + DisneyFresnel)
+    d_mf = tr_d(whn, ax, ay)
+    g_mf = tr_g1(wo, ax, ay) * tr_g1(wi, ax, ay)
+    fr_diel = fresnel_dielectric(cos_d, jnp.ones_like(e), e)
+    fr_schlick = cspec0 + _sw(cos_d)[..., None] * (1.0 - cspec0)
+    F_mf = (1.0 - dz.metallic)[..., None] * fr_diel[..., None] + dz.metallic[
+        ..., None
+    ] * fr_schlick
+    f4 = F_mf * (d_mf * g_mf / jnp.maximum(4.0 * ci * co, 1e-12))[..., None]
+    # 5: clearcoat (GTR1)
+    d_cc = _gtr1_d(jnp.abs(whn[..., 2]), gloss)
+    f_cc = 0.04 + 0.96 * _sw(cos_d)
+    g_cc = _smith_g_sep(ci, 0.25) * _smith_g_sep(co, 0.25)
+    f5 = (0.25 * dz.clearcoat * d_cc * f_cc * g_cc)[..., None] * jnp.ones_like(c)
+
+    refl_ok = (refl & ok_ang & (wh_len > 1e-12))[..., None]
+    f_refl = (
+        jnp.where(pr[0][..., None], f0, 0.0)
+        + jnp.where(pr[1][..., None], f1, 0.0)
+        + jnp.where(pr[2][..., None], f2, 0.0)
+        + jnp.where(pr[3][..., None], f3, 0.0)
+        + jnp.where(pr[4][..., None], f4, 0.0)
+        + jnp.where(pr[5][..., None], f5, 0.0)
+    )
+    f = jnp.where(refl_ok, f_refl, 0.0)
+
+    # 6: spec transmission (reconstruct the generalized half-vector)
+    T6 = dz.strans[..., None] * jnp.sqrt(jnp.maximum(c, 0.0))
+    eta_t = jnp.where(cos_theta(wo) > 0.0, e, 1.0 / jnp.maximum(e, 1e-6))
+    wh_t = wo + wi * eta_t[..., None]
+    wht_len = jnp.sqrt(jnp.sum(wh_t * wh_t, axis=-1))
+    wh_tn = wh_t / jnp.maximum(wht_len[..., None], 1e-20)
+    f6, p6, ok6 = _disney_trans_terms(T6, e, axt, ayt, wo, wi, wh_tn)
+    ok6 = ok6 & (wht_len > 1e-12)
+    f = f + jnp.where((pr[6] & ok6)[..., None], f6, 0.0)
+    # 7: thin diffuse transmission
+    f7 = (dt)[..., None] * c * _INV_PI
+    f = f + jnp.where((pr[7] & ~refl & ok_ang)[..., None], f7, 0.0)
+
+    # pdf: average over present lobes (cosine for 0-3, vndf for 4, GTR1
+    # for 5, transmission jacobian for 6, flipped cosine for 7)
+    pdf_cos = jnp.where(refl, cosine_hemisphere_pdf(ci), 0.0)
+    n_cos = sum(p.astype(jnp.float32) for p in pr[0:4])
+    pdf_mf = jnp.where(
+        refl & (wh_len > 1e-12),
+        tr_pdf(wo, whn, ax, ay)
+        / jnp.maximum(4.0 * jnp.abs(jnp.sum(wo * whn, axis=-1)), 1e-12),
+        0.0,
+    )
+    pdf_cc = jnp.where(
+        refl & (wh_len > 1e-12),
+        jnp.abs(d_cc * whn[..., 2])
+        / jnp.maximum(4.0 * jnp.abs(jnp.sum(wo * whn, axis=-1)), 1e-12),
+        0.0,
+    )
+    pdf_lt = jnp.where(~refl, cosine_hemisphere_pdf(ci), 0.0)
+    pdf_sum = (
+        n_cos * pdf_cos
+        + jnp.where(pr[4], pdf_mf, 0.0)
+        + jnp.where(pr[5], pdf_cc, 0.0)
+        + jnp.where(pr[6] & ok6, p6, 0.0)
+        + jnp.where(pr[7], pdf_lt, 0.0)
+    )
+    pdf = pdf_sum / jnp.maximum(n.astype(jnp.float32), 1.0)
+    dead = ~ok_ang
+    return jnp.where(dead[..., None], 0.0, f), jnp.where(dead, 0.0, pdf)
+
+
+def _disney_sample_wi(mp: MatParams, wo, u_lobe, u1, u2):
+    """Draw wi by picking uniformly among the PRESENT lobes (BSDF::
+    Sample_f component choice); f/pdf then come from _disney_f_pdf."""
+    dz = mp.dz
+    c, e, dw, dt, csheen, cspec0, ax, ay, axt, ayt, gloss = _disney_weights(mp)
+    pr, n = _disney_presence(mp)
+    nf = n.astype(jnp.float32)
+    k = jnp.minimum((u_lobe * nf).astype(jnp.int32), n - 1)
+    # k-th present lobe: lobe j is chosen when cumsum(pr)[j]-1 == k
+    cum = jnp.cumsum(jnp.stack([p.astype(jnp.int32) for p in pr]), axis=0)
+    sel = [(cum[j] - 1 == k) & pr[j] for j in range(8)]
+
+    sgn = jnp.where(cos_theta(wo) >= 0.0, 1.0, -1.0)
+    # cosine candidates (lobes 0-3 same side, 7 flipped)
+    wi_cos = cosine_sample_hemisphere(u1, u2)
+    wi_cos = wi_cos * jnp.stack(
+        [jnp.ones_like(sgn), jnp.ones_like(sgn), sgn], axis=-1
+    )
+    wi_lt = wi_cos * jnp.asarray([1.0, 1.0, -1.0])
+    # microfacet reflection (vndf)
+    wh_mf = tr_sample_wh(wo, u1, u2, ax, ay)
+    wi_mf = -wo + 2.0 * jnp.sum(wo * wh_mf, axis=-1)[..., None] * wh_mf
+    # clearcoat GTR1 half-vector (disney.cpp DisneyClearcoat::Sample_f)
+    a2 = gloss * gloss
+    ct_h = jnp.sqrt(
+        jnp.maximum(0.0, (1.0 - jnp.power(a2, 1.0 - u1)) / (1.0 - a2))
+    )
+    st_h = jnp.sqrt(jnp.maximum(0.0, 1.0 - ct_h * ct_h))
+    phi = 2.0 * jnp.pi * u2
+    wh_cc = jnp.stack([st_h * jnp.cos(phi), st_h * jnp.sin(phi), ct_h], -1)
+    wh_cc = jnp.where(same_hemisphere(wo, wh_cc)[..., None], wh_cc, -wh_cc)
+    wi_cc = -wo + 2.0 * jnp.sum(wo * wh_cc, axis=-1)[..., None] * wh_cc
+    # spec transmission: vndf on the (possibly thin-rescaled) dist
+    wh_st = tr_sample_wh(wo, u1, u2, axt, ayt)
+    eta_rel = jnp.where(
+        cos_theta(wo) > 0.0, 1.0 / jnp.maximum(e, 1e-6), e
+    )
+    wi_st, tir_st = _refract_about(wo, wh_st, eta_rel)
+
+    wi = wi_cos
+    wi = jnp.where(sel[4][..., None], wi_mf, wi)
+    wi = jnp.where(sel[5][..., None], wi_cc, wi)
+    wi = jnp.where(sel[6][..., None], wi_st, wi)
+    wi = jnp.where(sel[7][..., None], wi_lt, wi)
+    ln = jnp.sqrt(jnp.sum(wi * wi, axis=-1))
+    wi = wi / jnp.maximum(ln[..., None], 1e-20)
+    bad = (sel[6] & tir_st) | (ln < 1e-12)
+    return wi, bad
+
+
+
+
+# -------------------------------------------------------------------------
+# Hair BSDF (src/materials/hair.cpp, Chiang et al. 2016 "A Practical and
+# Controllable Hair and Fur Model"): longitudinal Mp / azimuthal
+# trimmed-logistic Np lobes for p = 0..3, dielectric attenuation Ap, and
+# the 2-degree scale-tilt recurrences. The local frame follows pbrt's
+# curve convention: x along the curve tangent, (y, z) the azimuthal
+# plane; h in [-1, 1] is the across-width offset (-1 + 2 * uv.v for the
+# tessellated flat ribbons). Traced only when a scene uses hair
+# (MatParams.hz gating).
+# -------------------------------------------------------------------------
+
+_H_PMAX = 3
+_SQRT_PI_OVER_8 = 0.626657069
+
+
+class HairParams(NamedTuple):
+    sigma_a: jnp.ndarray  # (R,3)
+    beta_m: jnp.ndarray  # (R,)
+    beta_n: jnp.ndarray
+    alpha: jnp.ndarray  # degrees
+    h: jnp.ndarray  # (R,) across-width offset, set from uv at shade time
+
+
+def _safe_sqrt(x):
+    return jnp.sqrt(jnp.maximum(x, 0.0))
+
+
+def _safe_asin(x):
+    return jnp.arcsin(jnp.clip(x, -1.0, 1.0))
+
+
+def _i0(x):
+    """Modified Bessel I0, 10-term series (hair.cpp I0)."""
+    val = jnp.zeros_like(x)
+    x2i = jnp.ones_like(x)
+    ifact = 1.0
+    i4 = 1.0
+    for i in range(10):
+        if i > 1:
+            ifact *= i
+        val = val + x2i / (i4 * ifact * ifact)
+        x2i = x2i * x * x
+        i4 *= 4.0
+    return val
+
+
+def _log_i0(x):
+    big = x > 12.0
+    lb = x + 0.5 * (-jnp.log(2.0 * jnp.pi) + jnp.log(1.0 / jnp.maximum(x, 1e-12)) + 1.0 / (8.0 * jnp.maximum(x, 1e-12)))
+    ls = jnp.log(jnp.maximum(_i0(jnp.minimum(x, 12.0)), 1e-38))
+    return jnp.where(big, lb, ls)
+
+
+def _mp(cos_ti, cos_to, sin_ti, sin_to, v):
+    a = cos_ti * cos_to / v
+    b = sin_ti * sin_to / v
+    small = v <= 0.1
+    m_small = jnp.exp(
+        _log_i0(a) - b - 1.0 / v + 0.6931 + jnp.log(1.0 / (2.0 * v))
+    )
+    vb = jnp.maximum(v, 0.05)  # keep the big-v branch finite under where
+    m_big = (
+        jnp.exp(-jnp.minimum(b, 80.0)) * _i0(jnp.minimum(a, 12.0))
+    ) / (jnp.sinh(jnp.minimum(1.0 / vb, 80.0)) * 2.0 * vb)
+    return jnp.where(small, m_small, m_big)
+
+
+def _logistic(x, s):
+    x = jnp.abs(x)
+    e = jnp.exp(-x / s)
+    return e / (s * (1.0 + e) ** 2)
+
+
+def _logistic_cdf(x, s):
+    return 1.0 / (1.0 + jnp.exp(-x / s))
+
+
+def _trimmed_logistic(x, s):
+    pi = jnp.pi
+    norm = _logistic_cdf(pi, s) - _logistic_cdf(-pi, s)
+    return _logistic(x, s) / jnp.maximum(norm, 1e-12)
+
+
+def _sample_trimmed_logistic(u, s):
+    pi = jnp.pi
+    k = _logistic_cdf(pi, s) - _logistic_cdf(-pi, s)
+    x = -s * jnp.log(
+        1.0 / jnp.maximum(u * k + _logistic_cdf(-pi, s), 1e-12) - 1.0
+    )
+    return jnp.clip(x, -pi, pi)
+
+
+def _hair_phi_p(p, gamma_o, gamma_t):
+    return 2.0 * p * gamma_t - 2.0 * gamma_o + p * jnp.pi
+
+
+def _wrap_pi(x):
+    return jnp.mod(x + jnp.pi, 2.0 * jnp.pi) - jnp.pi
+
+
+def _hair_setup(mp: MatParams, wo):
+    """Shared per-lane terms (hair.cpp f()/Pdf() prologue)."""
+    hz = mp.hz
+    eta = mp.eta[..., 0]
+    h = hz.h
+    bm = hz.beta_m
+    bn = hz.beta_n
+    v0 = (0.726 * bm + 0.812 * bm * bm + 3.7 * bm ** 20) ** 2
+    vs = [v0, 0.25 * v0, 4.0 * v0, 4.0 * v0]
+    s = _SQRT_PI_OVER_8 * (0.265 * bn + 1.194 * bn * bn + 5.372 * bn ** 22)
+    a_rad = jnp.radians(hz.alpha)
+    sin2k = [jnp.sin(a_rad)]
+    cos2k = [_safe_sqrt(1.0 - sin2k[0] ** 2)]
+    for i in range(1, 3):
+        sin2k.append(2.0 * cos2k[i - 1] * sin2k[i - 1])
+        cos2k.append(cos2k[i - 1] ** 2 - sin2k[i - 1] ** 2)
+
+    sin_to = wo[..., 0]
+    cos_to = _safe_sqrt(1.0 - sin_to * sin_to)
+    phi_o = jnp.arctan2(wo[..., 2], wo[..., 1])
+    sin_tt = sin_to / eta
+    cos_tt = _safe_sqrt(1.0 - sin_tt * sin_tt)
+    etap = _safe_sqrt(eta * eta - sin_to * sin_to) / jnp.maximum(cos_to, 1e-6)
+    sin_gt = h / jnp.maximum(etap, 1e-6)
+    cos_gt = _safe_sqrt(1.0 - sin_gt * sin_gt)
+    gamma_t = _safe_asin(sin_gt)
+    gamma_o = _safe_asin(h)
+    # transmittance of one internal segment
+    T = jnp.exp(
+        -hz.sigma_a * (2.0 * cos_gt / jnp.maximum(cos_tt, 1e-6))[..., None]
+    )
+    # attenuation Ap (hair.cpp Ap())
+    cos_go = _safe_sqrt(1.0 - h * h)
+    fr = fresnel_dielectric(cos_to * cos_go, jnp.ones_like(eta), eta)[..., None]
+    ap0 = jnp.broadcast_to(fr, T.shape)
+    ap1 = (1.0 - fr) ** 2 * T
+    ap2 = ap1 * T * fr
+    ap3 = ap2 * fr * T / jnp.maximum(1.0 - T * fr, 1e-4)
+    aps = [ap0, ap1, ap2, ap3]
+
+    # tilted longitudinal angles per p (hair.cpp "account for scales")
+    tilts = []
+    for p in range(3):
+        if p == 0:
+            st = sin_to * cos2k[1] - cos_to * sin2k[1]
+            ct = cos_to * cos2k[1] + sin_to * sin2k[1]
+        elif p == 1:
+            st = sin_to * cos2k[0] + cos_to * sin2k[0]
+            ct = cos_to * cos2k[0] - sin_to * sin2k[0]
+        else:
+            st = sin_to * cos2k[2] + cos_to * sin2k[2]
+            ct = cos_to * cos2k[2] - sin_to * sin2k[2]
+        tilts.append((st, jnp.abs(ct)))
+    tilts.append((sin_to, cos_to))
+
+    from tpu_pbrt.core.spectrum import luminance
+
+    ap_lum = [luminance(a) for a in aps]
+    tot = sum(ap_lum)
+    ap_pdf = [al / jnp.maximum(tot, 1e-12) for al in ap_lum]
+    return (eta, s, vs, gamma_o, gamma_t, phi_o, sin_to, cos_to, aps,
+            ap_pdf, tilts)
+
+
+def _hair_f_pdf(mp: MatParams, wo, wi):
+    """HairBSDF::f and ::Pdf."""
+    (eta, s, vs, gamma_o, gamma_t, phi_o, sin_to, cos_to, aps, ap_pdf,
+     tilts) = _hair_setup(mp, wo)
+    sin_ti = wi[..., 0]
+    cos_ti = _safe_sqrt(1.0 - sin_ti * sin_ti)
+    phi_i = jnp.arctan2(wi[..., 2], wi[..., 1])
+    phi = phi_i - phi_o
+    fsum = jnp.zeros_like(mp.kd)
+    pdf = jnp.zeros_like(sin_to)
+    for p in range(_H_PMAX):
+        st, ct = tilts[p]
+        m = _mp(cos_ti, ct, sin_ti, st, vs[p])
+        n = _trimmed_logistic(
+            _wrap_pi(phi - _hair_phi_p(p, gamma_o, gamma_t)), s
+        )
+        fsum = fsum + aps[p] * (m * n)[..., None]
+        pdf = pdf + ap_pdf[p] * m * n
+    st, ct = tilts[_H_PMAX]
+    m_last = _mp(cos_ti, ct, sin_ti, st, vs[_H_PMAX])
+    inv2pi = 1.0 / (2.0 * jnp.pi)
+    fsum = fsum + aps[_H_PMAX] * (m_last * inv2pi)[..., None]
+    pdf = pdf + ap_pdf[_H_PMAX] * m_last * inv2pi
+    f = fsum / jnp.maximum(abs_cos_theta(wi), 1e-6)[..., None]
+    ok = jnp.isfinite(pdf) & jnp.all(jnp.isfinite(f), axis=-1)
+    return jnp.where(ok[..., None], f, 0.0), jnp.where(ok, pdf, 0.0)
+
+
+def _hair_sample_wi(mp: MatParams, wo, u_lobe, u1, u2):
+    """HairBSDF::Sample_f direction draw: pick p by the attenuation
+    pdf, sample Mp longitudinally and the trimmed logistic azimuthally.
+    u_lobe is consumed for the p choice and its remainder reused for
+    the azimuthal sample (pbrt demuxes one sample the same way)."""
+    (eta, s, vs, gamma_o, gamma_t, phi_o, sin_to, cos_to, aps, ap_pdf,
+     tilts) = _hair_setup(mp, wo)
+    c0 = ap_pdf[0]
+    c1 = c0 + ap_pdf[1]
+    c2 = c1 + ap_pdf[2]
+    p_idx = (
+        (u_lobe >= c0).astype(jnp.int32)
+        + (u_lobe >= c1).astype(jnp.int32)
+        + (u_lobe >= c2).astype(jnp.int32)
+    )
+    prev = jnp.where(
+        p_idx == 0, 0.0,
+        jnp.where(p_idx == 1, c0, jnp.where(p_idx == 2, c1, c2)),
+    )
+    width = jnp.where(
+        p_idx == 0, c0,
+        jnp.where(
+            p_idx == 1, c1 - c0, jnp.where(p_idx == 2, c2 - c1, 1.0 - c2)
+        ),
+    )
+    u_np = jnp.clip((u_lobe - prev) / jnp.maximum(width, 1e-9), 0.0, 0.9999)
+
+    def sel(vals):
+        out = vals[0]
+        for p in range(1, 4):
+            out = jnp.where(p_idx == p, vals[p], out)
+        return out
+
+    v_p = sel(vs)
+    st_p = sel([t[0] for t in tilts])
+    ct_p = sel([t[1] for t in tilts])
+    u1c = jnp.maximum(u1, 1e-5)
+    cos_theta = 1.0 + v_p * jnp.log(
+        u1c
+        + (1.0 - u1c)
+        * jnp.exp(-jnp.minimum(2.0 / jnp.maximum(v_p, 1e-6), 80.0))
+    )
+    sin_theta = _safe_sqrt(1.0 - cos_theta * cos_theta)
+    cos_phi_s = jnp.cos(2.0 * jnp.pi * u2)
+    sin_ti = -cos_theta * st_p + sin_theta * cos_phi_s * ct_p
+    cos_ti = _safe_sqrt(1.0 - sin_ti * sin_ti)
+    dphi_smooth = sel(
+        [_hair_phi_p(p, gamma_o, gamma_t) for p in range(4)]
+    ) + _sample_trimmed_logistic(u_np, s)
+    dphi = jnp.where(p_idx < _H_PMAX, dphi_smooth, 2.0 * jnp.pi * u_np)
+    phi_i = phi_o + dphi
+    wi = jnp.stack(
+        [sin_ti, cos_ti * jnp.cos(phi_i), cos_ti * jnp.sin(phi_i)], axis=-1
+    )
+    return wi
+
+
 # -------------------------------------------------------------------------
 # Public API
 # -------------------------------------------------------------------------
@@ -548,6 +1106,23 @@ def bsdf_eval(mp: MatParams, wo, wi):
     f_rg, pdf_rg = _rough_glass_f_pdf(mp, wo, wi)
     f = jnp.where(rg[..., None], f_rg, f)
     pdf = jnp.where(rg, pdf_rg, pdf)
+    if mp.dz is not None:
+        dzl = mp.mtype == MAT_DISNEY
+        f_dz, pdf_dz = _disney_f_pdf(mp, wo, wi)
+        f = jnp.where(dzl[..., None], f_dz, f)
+        pdf = jnp.where(dzl, pdf_dz, pdf)
+    if mp.hz is not None:
+        hl = mp.mtype == MAT_HAIR
+        f_h, pdf_h = _hair_f_pdf(mp, wo, wi)
+        f = jnp.where(hl[..., None], f_h, f)
+        pdf = jnp.where(hl, pdf_h, pdf)
+    if mp.fz is not None:
+        from tpu_pbrt.core.fourierbsdf import fourier_f_pdf
+
+        fl = mp.mtype == MAT_FOURIER
+        f_fo, pdf_fo = fourier_f_pdf(mp.fz, wo, wi)
+        f = jnp.where(fl[..., None], f_fo, f)
+        pdf = jnp.where(fl, pdf_fo, pdf)
     dead = (is_spec & ~rg) | (mp.mtype == MAT_NONE)
     return jnp.where(dead[..., None], 0.0, f), jnp.where(dead, 0.0, pdf)
 
@@ -588,6 +1163,23 @@ def bsdf_sample(mp: MatParams, wo, u_lobe, u1, u2) -> BSDFSample:
     wi_g = jnp.where(use_cos[..., None], wi_d, wi_g)
 
     wi = jnp.where(pick_g[..., None], wi_g, wi_d)
+
+    dz_bad = None
+    if mp.dz is not None:
+        dzl = mp.mtype == MAT_DISNEY
+        wi_dz, bad_dz = _disney_sample_wi(mp, wo, u_lobe, u1, u2)
+        wi = jnp.where(dzl[..., None], wi_dz, wi)
+        dz_bad = dzl & bad_dz
+    if mp.hz is not None:
+        hl = mp.mtype == MAT_HAIR
+        wi_h = _hair_sample_wi(mp, wo, u_lobe, u1, u2)
+        wi = jnp.where(hl[..., None], wi_h, wi)
+    if mp.fz is not None:
+        from tpu_pbrt.core.fourierbsdf import fourier_sample_wi
+
+        fl = mp.mtype == MAT_FOURIER
+        wi_fo = fourier_sample_wi(wo, u_lobe, u1, u2)
+        wi = jnp.where(fl[..., None], wi_fo, wi)
 
     # --- combined f/pdf over matching non-specular lobes -----------------
     f_ns, pdf_ns = bsdf_eval(mp, wo, wi)
@@ -657,6 +1249,25 @@ def bsdf_sample(mp: MatParams, wo, u_lobe, u1, u2) -> BSDFSample:
     is_transmission = (is_glass & ~rg & ~reflect_g) | (flip_t & ~pick_g) | (
         rg & ~same_hemisphere(wo, wi)
     )
+    if dz_bad is not None:
+        dzl = mp.mtype == MAT_DISNEY
+        pdf = jnp.where(dz_bad, 0.0, pdf)
+        is_transmission = jnp.where(
+            dzl, ~same_hemisphere(wo, wi), is_transmission
+        )
+    if mp.hz is not None:
+        # hair has no radiance-scaling transmission; leave eta_scale alone
+        is_transmission = jnp.where(
+            mp.mtype == MAT_HAIR, jnp.zeros_like(is_transmission),
+            is_transmission,
+        )
+    if mp.fz is not None:
+        # the two-sided fourier sampler crosses hemispheres: medium
+        # interfaces must switch exactly as for any transmitted ray
+        is_transmission = jnp.where(
+            mp.mtype == MAT_FOURIER, ~same_hemisphere(wo, wi),
+            is_transmission,
+        )
     dead = (mp.mtype == MAT_NONE) | (pdf <= 0.0)
     f = jnp.where(dead[..., None], 0.0, f)
     pdf = jnp.where(dead, 0.0, pdf)

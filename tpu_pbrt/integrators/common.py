@@ -277,7 +277,19 @@ def make_interaction(dev, hit: Hit, o, d) -> Interaction:
     # orient geometric normal to the shading normal's hemisphere
     ng = face_forward(ng, ns)
     uv = b0[..., None] * tuv[..., 0, :] + b1[..., None] * tuv[..., 1, :] + b2[..., None] * tuv[..., 2, :]
-    ss, ts = coordinate_system(ns)
+    if "tri_tanT" in dev:
+        # uv-aligned shading tangent (triangle.cpp dpdu) — required by
+        # the hair BSDF (x axis along the curve); built only when the
+        # scene needs it, else the cheap arbitrary frame below
+        tan = jnp.moveaxis(jnp.take(dev["tri_tanT"], prim, axis=1), 0, -1)
+        tan = tan - ns * jnp.sum(tan * ns, axis=-1, keepdims=True)
+        tl = jnp.linalg.norm(tan, axis=-1, keepdims=True)
+        ss0, ts0 = coordinate_system(ns)
+        ok = tl[..., 0] > 1e-8
+        ss = jnp.where(ok[..., None], tan / jnp.maximum(tl, 1e-20), ss0)
+        ts = jnp.where(ok[..., None], cross(ns, ss), ts0)
+    else:
+        ss, ts = coordinate_system(ns)
     return Interaction(
         p=p,
         ng=ng,
@@ -299,6 +311,11 @@ def textured_mat(dev, mid, uv, p, tex_eval, tex_used) -> "bxdf.MatParams":
     evaluator's value at (uv, p). tex_used is a STATIC set — untextured
     slots cost nothing at trace time."""
     mp = bxdf.gather_mat(dev["mat"], mid)
+    if mp.hz is not None:
+        # hair: across-width offset h = -1 + 2*v from the ribbon uv
+        # (curve.cpp's flat-curve parameterization)
+        h = jnp.clip(-1.0 + 2.0 * uv[..., 1], -0.9995, 0.9995)
+        mp = mp._replace(hz=mp.hz._replace(h=h))
     if tex_eval is None or "tex_atlas" not in dev or not tex_used:
         return mp
     mt = dev["mat"]

@@ -470,8 +470,45 @@ def _rgb(v) -> np.ndarray:
 
 _ROUGH_SLOTS = ("roughness", "uroughness", "vroughness")
 
+#: Disney parameter slots, added to the material table only when a scene
+#: actually uses the disney material (keeps every other scene's gather
+#: and compile cost unchanged)
+_DISNEY_SLOTS = (
+    "d_metallic", "d_spectint", "d_aniso", "d_sheen", "d_sheentint",
+    "d_clearcoat", "d_ccgloss", "d_strans", "d_flat", "d_dtrans",
+)
 
-def lower_materials(mat_records: List, tex_registry) -> Dict[str, np.ndarray]:
+
+def _ensure_disney_slots(tab, m):
+    if "d_metallic" not in tab:
+        for s in _DISNEY_SLOTS:
+            tab[s] = np.zeros(m, np.float32)
+        tab["d_thin"] = np.zeros(m, np.int32)
+
+
+def _ensure_hair_slots(tab, m):
+    if "h_beta_m" not in tab:
+        tab["h_sigma_a"] = np.zeros((m, 3), np.float32)
+        tab["h_beta_m"] = np.full(m, 0.3, np.float32)
+        tab["h_beta_n"] = np.full(m, 0.3, np.float32)
+        tab["h_alpha"] = np.full(m, 2.0, np.float32)
+
+
+def _hair_sigma_a_from_reflectance(c, beta_n):
+    """HairBSDF::SigmaAFromReflectance (hair.cpp)."""
+    denom = (
+        5.969
+        - 0.215 * beta_n
+        + 2.532 * beta_n**2
+        - 10.73 * beta_n**3
+        + 5.574 * beta_n**4
+        + 0.245 * beta_n**5
+    )
+    return (np.log(np.maximum(np.asarray(c, np.float64), 1e-4)) / denom) ** 2
+
+
+def lower_materials(mat_records: List, tex_registry,
+                    scene_dir: str = ".") -> Dict[str, np.ndarray]:
     """MaterialRecords -> SoA table. tex_registry assigns ids to
     non-constant textures (returns -1 for constants)."""
     m = len(mat_records)
@@ -589,20 +626,106 @@ def lower_materials(mat_records: List, tex_registry) -> Dict[str, np.ndarray]:
             tab["rough_v"][i] = tab["rough_u"][i]
             tab["remap"][i] = int(p.get("remaproughness", True))
         elif t == "disney":
+            # full Disney 2015 lobe set (disney.cpp): parameters land in
+            # dedicated d_* slots added lazily below; the shared slots
+            # carry color/rough/eta for the generic machinery
+            _ensure_disney_slots(tab, m)
             fold_spec(rec, "color", 0.5, "kd", "kd_tex", i)
             fold_f(rec, "roughness", 0.5, "rough_u", "rough_tex", i)
             tab["rough_v"][i] = tab["rough_u"][i]
-            fold_f(rec, "metallic", 0.0, "sigma", None, i)  # sigma slot reused
             fold_f(rec, "eta", 1.5, "eta", None, i)
             tab["eta"][i] = tab["eta"][i][:1].repeat(3)
             tab["remap"][i] = 0
-        elif t in ("hair", "fourier", "subsurface", "kdsubsurface"):
-            # approximated at shade time; carry diffuse color fallback
-            Warning(f'material "{t}" approximated in this build; using closest analytic model')
+            for key, slot, dflt in (
+                ("metallic", "d_metallic", 0.0),
+                ("speculartint", "d_spectint", 0.0),
+                ("anisotropic", "d_aniso", 0.0),
+                ("sheen", "d_sheen", 0.0),
+                ("sheentint", "d_sheentint", 0.5),
+                ("clearcoat", "d_clearcoat", 0.0),
+                ("clearcoatgloss", "d_ccgloss", 1.0),
+                ("spectrans", "d_strans", 0.0),
+                ("flatness", "d_flat", 0.0),
+                ("difftrans", "d_dtrans", 1.0),
+            ):
+                fold_f(rec, key, dflt, slot, None, i)
+            thin, _ = _fold_const(p.get("thin"), False)
+            tab["d_thin"][i] = 1 if thin else 0
+            sd, _ = _fold_const(p.get("scatterdistance"), 0.0)
+            if np.any(np.asarray(sd, np.float64) > 0):
+                Warning(
+                    "disney scatterdistance > 0 (subsurface) is not "
+                    "supported; shading as the solid Disney BSDF"
+                )
+        elif t == "hair":
+            # full Chiang/pbrt HairBSDF (hair.cpp): sigma_a resolution
+            # order matches HairMaterial::ComputeScatteringFunctions
+            _ensure_hair_slots(tab, m)
+            bn, _ = _fold_const(p.get("beta_n"), 0.3)
+            bn = float(np.asarray(bn, np.float64).reshape(-1).mean())
+            if p.get("sigma_a") is not None:
+                sa, _ = _fold_const(p.get("sigma_a"), 1.3)
+                sa = _rgb(sa)
+            elif p.get("color") is not None:
+                col, _ = _fold_const(p.get("color"), 0.5)
+                sa = _hair_sigma_a_from_reflectance(_rgb(col), bn)
+            else:
+                eu, _ = _fold_const(p.get("eumelanin"), 1.3)
+                ph, _ = _fold_const(p.get("pheomelanin"), 0.0)
+                eu = float(np.asarray(eu, np.float64).reshape(-1).mean())
+                ph = float(np.asarray(ph, np.float64).reshape(-1).mean())
+                # HairMaterial: eumelanin/pheomelanin absorption spectra
+                sa = eu * np.array([0.419, 0.697, 1.37]) + ph * np.array(
+                    [0.187, 0.4, 1.05]
+                )
+            tab["h_sigma_a"][i] = np.asarray(sa, np.float32)
+            fold_f(rec, "beta_m", 0.3, "h_beta_m", None, i)
+            tab["h_beta_n"][i] = bn
+            fold_f(rec, "alpha", 2.0, "h_alpha", None, i)
+            fold_f(rec, "eta", 1.55, "eta", None, i)
+            tab["eta"][i] = tab["eta"][i][:1].repeat(3)
+            # fallback color for integrators that only store diffuse
+            tab["kd"][i] = np.exp(-np.asarray(sa, np.float64) * 0.5)
+        elif t == "fourier":
+            # real tabulated FourierBSDF when the .bsdf file loads
+            # (core/fourierbsdf.py); loud diffuse fallback otherwise
+            fn, _ = _fold_const(p.get("bsdffile"), "")
+            prev = tab.get("_fourier")
+            tab_obj = None
+            if fn and prev is not None and prev[1] == str(fn):
+                tab_obj = prev[0]  # same file: reuse, skip the re-read
+            elif fn and prev is not None:
+                Warning(
+                    "multiple distinct fourier bsdffiles in one scene "
+                    "are not supported; reusing the first table"
+                )
+                tab_obj = prev[0]
+            elif fn:
+                from tpu_pbrt.core.fourierbsdf import read_bsdf_file
+                from tpu_pbrt.utils.fileutil import resolve_filename
+
+                try:
+                    tab_obj = read_bsdf_file(resolve_filename(str(fn), scene_dir))
+                    tab["_fourier"] = (tab_obj, str(fn))
+                except Exception as e:  # noqa: BLE001
+                    Warning(f'fourier: could not read "{fn}" ({e}); '
+                            "SUBSTITUTING a 0.5 diffuse BSDF")
+            else:
+                Warning('fourier material without "bsdffile"; '
+                        "SUBSTITUTING a 0.5 diffuse BSDF")
+            if tab_obj is None:
+                tab["type"][i] = MAT_MATTE
+            tab["kd"][i] = 0.5
+        elif t in ("subsurface", "kdsubsurface"):
+            # no BSSRDF transport yet: SUBSTITUTED by a diffuse surface
+            Warning(
+                f'material "{t}" has no BSSRDF transport in this build; '
+                "SUBSTITUTING a diffuse surface BSDF (subsurface "
+                "scattering will be missing)"
+            )
             fold_spec(rec, "Kd" if p.get("Kd") is not None else "color", 0.5, "kd", "kd_tex", i)
-            if t in ("subsurface", "kdsubsurface"):
-                fold_f(rec, "eta", 1.33, "eta", None, i)
-                tab["eta"][i] = tab["eta"][i][:1].repeat(3)
+            fold_f(rec, "eta", 1.33, "eta", None, i)
+            tab["eta"][i] = tab["eta"][i][:1].repeat(3)
         elif t == "mix":
             # lower to the first material's model blended by constant amount
             amt, _ = _fold_const(p.get("amount"), 0.5)
@@ -1112,7 +1235,8 @@ def compile_scene(api) -> CompiledScene:
             deferred_textures.append(node)
         return tid
 
-    mtab = lower_materials(mat_records, tex_registry)
+    mtab = lower_materials(mat_records, tex_registry,
+                           getattr(api, "scene_dir", "."))
 
     tex_eval = None
     tex_atlas = None
@@ -1151,7 +1275,10 @@ def compile_scene(api) -> CompiledScene:
         "tri_uvs": jnp.asarray(uvs, jnp.float32),
         "tri_mat": jnp.asarray(mat_ids, jnp.int32),
         "tri_light": jnp.asarray(light_ids, jnp.int32),
-        "mat": {k: jnp.asarray(v) for k, v in mtab.items()},
+        "mat": {
+            k: (v[0] if k == "_fourier" else jnp.asarray(v))
+            for k, v in mtab.items()
+        },
         "light": {k: jnp.asarray(v) for k, v in lt.items()},
         "tri_med_in": jnp.asarray(med_in, jnp.int32),
         "tri_med_out": jnp.asarray(med_out, jnp.int32),
@@ -1185,6 +1312,25 @@ def compile_scene(api) -> CompiledScene:
                 axis=1,
             ).T.copy()
         )
+    if "h_beta_m" in mtab:
+        # hair needs the shading tangent ALONG the curve: per-triangle
+        # dpdu from the uv parameterization (triangle.cpp dpdu), stored
+        # lane-major (3, T). Built only when a hair material exists.
+        duv02 = uvs[:, 0] - uvs[:, 2]
+        duv12 = uvs[:, 1] - uvs[:, 2]
+        dp02 = verts[:, 0] - verts[:, 2]
+        dp12 = verts[:, 1] - verts[:, 2]
+        det = duv02[:, 0] * duv12[:, 1] - duv02[:, 1] * duv12[:, 0]
+        safe = np.abs(det) > 1e-12
+        inv = 1.0 / np.where(safe, det, 1.0)
+        dpdu = (
+            duv12[:, 1:2] * dp02 - duv02[:, 1:2] * dp12
+        ) * inv[:, None]
+        ln = np.linalg.norm(dpdu, axis=-1, keepdims=True)
+        dpdu = np.where(
+            safe[:, None] & (ln > 1e-12), dpdu / np.maximum(ln, 1e-20), 0.0
+        )
+        dev["tri_tanT"] = jnp.asarray(dpdu.T.copy(), jnp.float32)  # (3, T)
     if light_rows:
         # per-light triangle vertices (area lights; zeros elsewhere) so
         # light sampling never gathers the big tri_verts array by the
