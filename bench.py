@@ -77,7 +77,8 @@ def compute_mse(mse_res: int, mse_spp: int, ref_spp: int):
 
 
 def main():
-    spp = int(os.environ.get("BENCH_SPP", "64"))
+    # judged work shape (BASELINE.json: killeroo/crown @ 256spp)
+    spp = int(os.environ.get("BENCH_SPP", "256"))
     res = int(os.environ.get("BENCH_RES", "512"))
 
     from tpu_pbrt.scenes import compile_api, make_killeroo_like
@@ -90,8 +91,10 @@ def main():
     # ate the budget — a compile-tainted number still beats no number.
     result = integ.render(scene, max_seconds=5)
     if remaining() > 60:
+        # steady-state throughput stabilizes well before completion; box
+        # the main leg so the MSE and crown legs fit the total budget
         result = integ.render(
-            scene, max_seconds=min(remaining() - 30.0, remaining() * 0.55)
+            scene, max_seconds=min(remaining() - 30.0, max(60.0, remaining() * 0.22))
         )
 
     # measured rays per camera ray from the run just completed (the class
@@ -120,6 +123,52 @@ def main():
     if not (img_mean > 1e-6):
         _last_line["error"] = "image is black — tracer broken"
 
+    # crown-class row (VERDICT r4 #5): >=1M-tri glass+metal-GGX+HDR-env
+    # scene, reported as crown_* fields of the same JSON line (the
+    # driver parses exactly one line). Runs BEFORE the MSE leg but
+    # reserves its predicted cost so the judged accuracy number is
+    # never starved.
+    crown = None
+    mse_res = int(os.environ.get("MSE_RES", "128"))
+    mse_spp = int(os.environ.get("MSE_SPP", "256"))
+    est_rays = mse_res * mse_res * mse_spp * rays_ratio
+    mse_reserve = (
+        0.0 if os.environ.get("BENCH_SKIP_MSE")
+        # + ~95 s: the 128^2 MSE scene is a different shape and pays its
+        # own jit compile, which est_rays/throughput cannot see
+        else est_rays / max(result.mray_per_sec, 1e-6) / 1e6 + 95.0
+    )
+    if not os.environ.get("BENCH_SKIP_CROWN") and remaining() - mse_reserve > 90:
+        try:
+            from tpu_pbrt.scenes import make_crown_like
+
+            capi = make_crown_like(
+                res=int(os.environ.get("CROWN_RES", "512")),
+                spp=int(os.environ.get("CROWN_SPP", "256")),
+            )
+            cscene, cinteg = compile_api(capi)
+            cinteg.render(cscene, max_seconds=5)  # warmup (jit compile)
+            # the 1M-tri compile above is unbudgeted: re-check that the
+            # judged MSE leg still fits before spending more here
+            budget = remaining() - mse_reserve - 15.0
+            if budget < 10.0:
+                raise RuntimeError("crown skipped post-compile: budget")
+            cres = cinteg.render(cscene, max_seconds=budget)
+            import numpy as _np
+
+            cmean = float(_np.mean(_np.asarray(cres.image, _np.float32)))
+            crown = {
+                "crown_mray_per_sec": round(cres.mray_per_sec, 3),
+                "crown_completed_fraction": round(cres.completed_fraction, 4),
+                "crown_rays_traced": cres.rays_traced,
+                "crown_image_mean": round(cmean, 6),
+            }
+            _last_line.update(crown)
+        except Exception as e:  # noqa: BLE001
+            crown = {"crown_error": f"{type(e).__name__}: {e}"}
+    elif not os.environ.get("BENCH_SKIP_CROWN"):
+        print(f"skipping crown row: {remaining():.0f}s left", file=sys.stderr)
+
     mse = None
     if not os.environ.get("BENCH_SKIP_MSE"):
         try:
@@ -145,6 +194,8 @@ def main():
     if mse is not None:
         line["mse_vs_cpu_ref"] = mse
         line["mse_target"] = 1e-4
+    if crown:
+        line.update(crown)
     print(json.dumps(line))
 
 

@@ -393,3 +393,50 @@ def test_pallas_leaf_kernel_parity_interpret():
         np.asarray(t_pal)[hit_pal], np.asarray(t_ref)[hit_ref], rtol=1e-5, atol=1e-6
     )
     np.testing.assert_array_equal(np.asarray(k_pal)[hit_pal], np.asarray(k_ref)[hit_ref])
+
+
+def test_capacity_overflow_detected_and_loud(monkeypatch):
+    """VERDICT r4 #6, two halves: (a) starved worklists really do count
+    drops in-kernel; (b) a render whose audit sees drops raises unless
+    the escape hatch is set."""
+    import pytest
+
+    import tpu_pbrt.integrators.common as C
+    from tpu_pbrt.accel.stream import stream_traverse_stats
+    from tpu_pbrt.scenes import compile_api, make_killeroo_like
+
+    # (a) real drops: shrink the stack headroom far below a fat wave
+    monkeypatch.setenv("TPU_PBRT_HEADROOM", "0.0")
+    monkeypatch.setenv("TPU_PBRT_SLAB", "4096")
+    api = make_killeroo_like(res=64, spp=2)
+    scene, integ = compile_api(api)
+    dev = scene.dev
+    n = 1 << 18
+    k = jnp.arange(n, dtype=jnp.int32)
+    pf = jnp.stack(
+        [(k % 64).astype(jnp.float32) + 0.5,
+         ((k // 64) % 64).astype(jnp.float32) + 0.5], -1)
+    from tpu_pbrt.cameras import generate_rays
+
+    o, d, _ = generate_rays(scene.camera, pf, jnp.zeros_like(pf))
+    *_, drops, _ = stream_traverse_stats(dev["tstream"], o, d, jnp.inf)
+    assert int(drops) > 0, "starved worklists must register drops"
+
+    # (b) the render-side audit fails loudly on any drop (patch the
+    # audit seam so this leg does not depend on chunk-size heuristics)
+    monkeypatch.delenv("TPU_PBRT_HEADROOM", raising=False)
+    monkeypatch.delenv("TPU_PBRT_SLAB", raising=False)
+    import tpu_pbrt.accel.stream as stream_mod
+
+    real_stats = stream_mod.stream_traverse_stats
+    fake = lambda *a, **kw: (  # noqa: E731
+        jnp.int32(1), jnp.int32(1), jnp.int32(7), jnp.int32(1))
+    monkeypatch.setattr(stream_mod, "stream_traverse_stats", fake)
+    api2 = make_killeroo_like(res=16, spp=1)
+    scene2, integ2 = compile_api(api2)
+    with pytest.raises(RuntimeError, match="dropped 7 traversal pairs"):
+        integ2.render(scene2)
+    monkeypatch.setenv("TPU_PBRT_ALLOW_DROPS", "1")
+    res = integ2.render(scene2)
+    assert res.completed_fraction == 1.0
+    monkeypatch.setattr(stream_mod, "stream_traverse_stats", real_stats)

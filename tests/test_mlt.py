@@ -49,3 +49,28 @@ def test_mlt_concentrates_on_bright_regions():
     ).mean(-1).ravel()
     c = np.corrcoef(p, m)[0, 1]
     assert c > 0.8, f"mlt image decorrelated from path ({c:.2f})"
+
+
+def test_mlt_multi_device_matches_single():
+    """Mesh MLT (chains sharded with global ids, splats psum-merged)
+    must equal the single-device render up to f32 splat order."""
+    import jax
+    import pytest
+
+    from tpu_pbrt.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    kw = dict(n_bootstrap=4096, n_chains=512, mutations_per_pixel=64)
+    single = np.asarray(_render("mlt", 2, **kw).image)
+
+    api = make_cornell(res=16, spp=64, integrator="mlt", maxdepth=2)
+    scene, integ = compile_api(api)
+    for k, v in kw.items():
+        setattr(integ, k, v)
+    multi = np.asarray(integ.render(scene, mesh=make_mesh(4)).image)
+
+    assert np.isfinite(multi).all()
+    assert abs(multi.mean() - single.mean()) / max(single.mean(), 1e-9) < 1e-3
+    denom = np.maximum(np.abs(single), 1e-3)
+    assert float((np.abs(multi - single) / denom).max()) < 1e-2

@@ -1,0 +1,93 @@
+"""Ray differentials + trilinear mipmap selection (VERDICT r4 #4):
+camera.cpp GenerateRayDifferential + interaction.cpp
+ComputeDifferentials + mipmap.h Lookup. The oracle is pbrt's own
+motivation: a fine checkerboard receding to the horizon aliases badly
+at level 0 but converges to the 0.5 gray mean under proper filtering."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+
+def _render_checker_floor(eval_mode, spp=4):
+    """A high-frequency checker imagemap on a huge receding floor."""
+    import tpu_pbrt
+    from tpu_pbrt.utils.imageio import write_image
+
+    # 64x64 checkerboard texture with 1-texel squares
+    tex = ((np.indices((64, 64)).sum(axis=0) % 2) * 1.0).astype(np.float32)
+    tex = np.repeat(tex[:, :, None], 3, axis=2)
+    with tempfile.NamedTemporaryFile(suffix=".pfm", delete=False) as f:
+        tex_path = f.name
+    write_image(tex_path, tex)
+
+    scene = f"""
+Integrator "path" "integer maxdepth" [1]
+Sampler "random" "integer pixelsamples" [{spp}]
+Film "image" "integer xresolution" [48] "integer yresolution" [48]
+LookAt 0 1 0  0 1 10  0 1 0
+Camera "perspective" "float fov" [60]
+WorldBegin
+LightSource "distant" "rgb L" [3.14159 3.14159 3.14159] "point from" [0 1 0] "point to" [0 0 0]
+Texture "chk" "color" "imagemap" "string filename" ["{tex_path}"]
+  "float uscale" [100] "float vscale" [100]
+Material "matte" "texture Kd" ["chk"]
+Shape "trianglemesh" "integer indices" [0 1 2 0 2 3]
+  "point P" [-200 0 0  200 0 0  200 0 400  -200 0 400]
+  "float uv" [0 0  1 0  1 1  0 1]
+WorldEnd
+"""
+    with tempfile.NamedTemporaryFile("w", suffix=".pbrt", delete=False) as f:
+        f.write(scene)
+        path = f.name
+    old = os.environ.get("TPU_PBRT_MIPFILTER")
+    try:
+        if eval_mode == "level0":
+            os.environ["TPU_PBRT_MIPFILTER"] = "0"
+        else:
+            os.environ.pop("TPU_PBRT_MIPFILTER", None)
+        res = tpu_pbrt.render_file(path)
+        return np.asarray(res.image)
+    finally:
+        if old is None:
+            os.environ.pop("TPU_PBRT_MIPFILTER", None)
+        else:
+            os.environ["TPU_PBRT_MIPFILTER"] = old
+        os.unlink(path)
+        os.unlink(tex_path)
+
+
+def test_distant_checker_filters_toward_mean():
+    """Far rows of a receding fine checker must approach the checker
+    mean (0.5 albedo) under trilinear mip filtering, while the level-0
+    path stays noisy/aliased there. Albedo ~0.5 under a head-on distant
+    light of radiance pi means pixel values near 0.5."""
+    img_f = _render_checker_floor("filtered")
+    img_0 = _render_checker_floor("level0")
+    assert np.isfinite(img_f).all() and np.isfinite(img_0).all()
+
+    # the rows just under the horizon (image center) see the distant
+    # floor: footprint spans many checker cells -> filtered variance
+    # collapses
+    far_f = img_f[25:31, :, 0]
+    far_0 = img_0[25:31, :, 0]
+    var_f = float(far_f.var())
+    var_0 = float(far_0.var())
+    assert var_f < 0.35 * var_0, (
+        f"filtered far-field variance {var_f:.5f} vs level0 {var_0:.5f}"
+    )
+    # and the filtered far field sits near the true mean
+    assert abs(float(far_f.mean()) - 0.5) < 0.08, float(far_f.mean())
+
+
+def test_near_field_unchanged():
+    """Close to the camera the footprint is sub-texel: filtering must
+    leave the checker essentially as sharp as level 0."""
+    img_f = _render_checker_floor("filtered")
+    img_0 = _render_checker_floor("level0")
+    near_f = img_f[40:, :, 0]
+    near_0 = img_0[40:, :, 0]
+    # contrast (std) preserved within 25%
+    assert near_f.std() > 0.75 * near_0.std()

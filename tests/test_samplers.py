@@ -102,7 +102,9 @@ def test_dimension_decorrelation():
 
 
 def test_sampler_name_dispatch():
-    assert normalize_sampler_name("sobol") == "02"
+    assert normalize_sampler_name("sobol") == "sobol"
+    assert normalize_sampler_name("zerotwosequence") == "02"
+    assert normalize_sampler_name("maxmindist") == "02"  # loud substitute
     assert normalize_sampler_name("halton") == "halton"
     assert normalize_sampler_name("random") == "random"
     assert normalize_sampler_name("stratified") == "stratified"

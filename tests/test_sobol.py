@@ -1,0 +1,149 @@
+"""True Sobol' sampler tests (samplers/sobol.cpp capability, VERDICT r4
+#7): generator-matrix validity, the global interval-to-index remap,
+stratification, and the variance win over random sampling on cornell."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_pbrt.core.sampling import (
+    N_SOBOL_DIMS,
+    _SOBOL_V,
+    _sobol_raw_bits,
+    sobol_interval_to_index,
+    sobol_sample,
+)
+
+
+def test_matrices_valid():
+    """Every dimension's generator matrix must have nonsingular leading
+    minors over GF(2) — the condition making it a base-2
+    (0,1)-sequence (perfect 2^k stratification of every prefix)."""
+
+    def leading_minors_nonsingular(cols, kmax=16):
+        # row r of the k x k minor: bit (31 - r) of columns 0..k-1
+        for k in range(1, kmax + 1):
+            rows = []
+            for r in range(k):
+                bits = 0
+                for c in range(k):
+                    bits |= (((int(cols[c]) >> (31 - r)) & 1) << c)
+                rows.append(bits)
+            # gaussian elimination over GF(2)
+            for col in range(k):
+                piv = next(
+                    (r for r in range(col, k) if (rows[r] >> col) & 1), None
+                )
+                if piv is None:
+                    return False
+                rows[col], rows[piv] = rows[piv], rows[col]
+                for r in range(k):
+                    if r != col and ((rows[r] >> col) & 1):
+                        rows[r] ^= rows[col]
+        return True
+
+    for d in range(N_SOBOL_DIMS):
+        assert leading_minors_nonsingular(_SOBOL_V[d]), f"dim {d}"
+
+
+def test_remap_lands_in_pixel():
+    """SobolIntervalToIndex: sample `frame` of pixel p maps to a global
+    index whose dims 0/1 fall inside p (the defining property)."""
+    m = 4
+    res = 1 << m
+    px, py = jnp.meshgrid(jnp.arange(res), jnp.arange(res), indexing="ij")
+    px = px.reshape(-1).astype(jnp.int32)
+    py = py.reshape(-1).astype(jnp.int32)
+    scale = res * 2.3283064365386963e-10
+    for frame in range(8):
+        idx = sobol_interval_to_index(m, jnp.int32(frame), px, py)
+        gx = (np.asarray(_sobol_raw_bits(idx, 0)).astype(np.uint32) * scale).astype(int)
+        gy = (np.asarray(_sobol_raw_bits(idx, 1)).astype(np.uint32) * scale).astype(int)
+        assert (gx == np.asarray(px)).all() and (gy == np.asarray(py)).all()
+        # and distinct frames get distinct global indices
+    i0 = sobol_interval_to_index(m, jnp.int32(0), px, py)
+    i1 = sobol_interval_to_index(m, jnp.int32(1), px, py)
+    assert (np.asarray(i0) != np.asarray(i1)).all()
+
+
+def test_dimension_stratification():
+    """First 2^k samples of every dimension hit every 1/2^k stratum
+    exactly once (elementary-interval property), scrambled or not."""
+    n = 1 << 10
+    i = jnp.arange(n, dtype=jnp.int32)
+    for dim in (0, 1, 2, 7, 23, 63):
+        u = np.asarray(sobol_sample(i, dim))
+        counts = np.bincount((u * n).astype(int), minlength=n)
+        assert (counts == 1).all(), f"dim {dim} unscrambled"
+        u2 = np.asarray(sobol_sample(i, dim, jnp.uint32(0xABCD + dim)))
+        counts2 = np.bincount((u2 * n).astype(int), minlength=n)
+        assert (counts2 == 1).all(), f"dim {dim} owen-scrambled"
+
+
+def test_pair_01_is_02_sequence():
+    """Dims (0,1) of the first 2^k samples form a (0,2)-sequence: every
+    elementary box at total depth k holds exactly one point."""
+    n = 1 << 8
+    i = jnp.arange(n, dtype=jnp.int32)
+    x = np.asarray(sobol_sample(i, 0))
+    y = np.asarray(sobol_sample(i, 1))
+    for kx in range(0, 9):
+        ky = 8 - kx
+        bx = (x * (1 << kx)).astype(int)
+        by = (y * (1 << ky)).astype(int)
+        cells = bx * (1 << ky) + by
+        counts = np.bincount(cells, minlength=n)
+        assert (counts == 1).all(), f"box split {kx}/{ky}"
+
+
+def test_estimator_variance_beats_random():
+    """VERDICT r4 #7 done-criterion (measured variance win at equal
+    sample count): integrating a smooth 2D integrand with each pixel's
+    spp draws from the REAL sample_2d path, the sobol sampler's
+    per-pixel estimator variance must be far below random's. (A full
+    render of the 16x16 cornell cannot show this: its MSE is dominated
+    by silhouette pixels whose binary-visibility integrand defeats any
+    stratification — all samplers tie there, measured.)"""
+    from tpu_pbrt.core.sampling import sample_2d, set_sobol_resolution
+
+    set_sobol_resolution((64, 64))
+    spp = 16
+    n_pix = 1024
+    pix = jnp.arange(n_pix, dtype=jnp.int32)
+    px = pix % 64
+    py = pix // 64
+    # smooth integrand with known mean: E[sin(pi u) * v^2] = (2/pi)*(1/3)
+    truth = (2.0 / np.pi) * (1.0 / 3.0)
+
+    def pixel_means(kind):
+        acc = jnp.zeros((n_pix,), jnp.float32)
+        for s in range(spp):
+            u, v = sample_2d(kind, spp, px, py,
+                             jnp.full((n_pix,), s, jnp.int32), 5)
+            acc = acc + jnp.sin(jnp.pi * u) * v * v
+        return np.asarray(acc / spp)
+
+    var_rand = float(((pixel_means("random") - truth) ** 2).mean())
+    var_sob = float(((pixel_means("sobol") - truth) ** 2).mean())
+    assert var_sob < 0.2 * var_rand, (
+        f"sobol estimator variance {var_sob:.2e} not far below "
+        f"random {var_rand:.2e}"
+    )
+
+
+def test_render_no_regression_vs_random():
+    """Render-level guard: on the (edge-dominated) cornell box the sobol
+    sampler must at least not LOSE to random."""
+    from tpu_pbrt.scenes import compile_api, make_cornell
+
+    def render(sampler, spp):
+        api = make_cornell(res=16, spp=spp, integrator="path", maxdepth=2,
+                           sampler=sampler)
+        scene, integ = compile_api(api)
+        return np.asarray(integ.render(scene).image)
+
+    ref = render("random", 256)
+    mse_rand = float(((render("random", 8) - ref) ** 2).mean())
+    mse_sob = float(((render("sobol", 8) - ref) ** 2).mean())
+    assert mse_sob < 1.25 * mse_rand, (
+        f"sobol mse {mse_sob:.5f} regressed vs random {mse_rand:.5f}"
+    )

@@ -100,3 +100,31 @@ def test_sppm_radius_shrinks():
     assert np.isfinite(img).all()
     assert img.mean() > 1e-4
     assert r.stats["photons_dropped"] == 0
+
+
+def test_sppm_multi_device_matches_single():
+    """VERDICT r4 #2: a mesh SPPM render (pixels + photons sharded,
+    deposits all-gathered over ICI) must equal the single-device render
+    up to f32 accumulation order — the sharded photon-id ranges union to
+    EXACTLY the single-device photon set."""
+    import jax
+
+    from tpu_pbrt.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    scene, integ = _make(spp=4, res=16, md=3, photons=4096)
+    single = np.asarray(integ.render(scene).image)
+
+    scene2, integ2 = _make(spp=4, res=16, md=3, photons=4096)
+    mesh = make_mesh(4)
+    multi = np.asarray(integ2.render(scene2, mesh=mesh).image)
+
+    assert np.isfinite(multi).all()
+    # identical photon set + exhaustive gather: only f32 summation order
+    # differs; the sort order inside runs can also permute, so allow a
+    # small relative envelope rather than bit equality
+    denom = np.maximum(np.abs(single), 1e-3)
+    rel = np.abs(multi - single) / denom
+    assert float(rel.max()) < 2e-2, f"max rel dev {rel.max():.3e}"
+    assert abs(multi.mean() - single.mean()) / max(single.mean(), 1e-9) < 2e-3

@@ -183,8 +183,14 @@ def _sizes(R: int):
 
     cap = int(os.environ.get("TPU_PBRT_SLAB", 1 << 17))
     slab = int(min(max(R // 4, 4096), cap))
-    w = R + 24 * slab
-    lb = 12 * slab
+    # TPU_PBRT_HEADROOM scales the worklist headroom (default 1.0);
+    # the capacity-overflow regression test shrinks it to force drops.
+    # Floors: the stack must hold at least one push burst, and the leaf
+    # buffer must exceed the 8*slab flush threshold or _traverse would
+    # flush empty buffers forever.
+    head = float(os.environ.get("TPU_PBRT_HEADROOM", "1.0"))
+    w = R + max(int(24 * slab * head), slab // 2)
+    lb = max(int(12 * slab * head), 9 * slab)
     return slab, w, lb
 
 

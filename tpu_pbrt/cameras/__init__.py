@@ -239,3 +239,48 @@ def generate_rays(cam: CompiledCamera, p_film, u_lens):
     d_w = normalize(_xform_vector(cam.camera_to_world, d))
     weight = jnp.ones(p_film.shape[:-1], jnp.float32)
     return o_w, d_w, weight
+
+
+def ray_differentials(cam: CompiledCamera, p_film):
+    """Camera::GenerateRayDifferential's offset-ray deltas (camera.cpp):
+    world-space (d_origin/dx, d_dir/dx, d_origin/dy, d_dir/dy) for a
+    +1-raster-pixel step. Pinhole-analytic; the thin-lens origin jitter
+    is ignored exactly as pbrt's differentials assume the primary ray."""
+    zero = jnp.zeros(p_film.shape[:-1] + (3,), jnp.float32)
+    if cam.cam_type == CAM_ENVIRONMENT:
+        x, y = p_film[..., 0], p_film[..., 1]
+
+        def dir_at(xx, yy):
+            theta = jnp.pi * yy / cam.full_res[1]
+            phi = 2.0 * jnp.pi * xx / cam.full_res[0]
+            d = jnp.stack(
+                [jnp.sin(theta) * jnp.cos(phi), jnp.cos(theta),
+                 jnp.sin(theta) * jnp.sin(phi)], axis=-1)
+            return normalize(_xform_vector(cam.camera_to_world, d))
+
+        base = dir_at(x, y)
+        return (zero, dir_at(x + 1.0, y) - base,
+                zero, dir_at(x, y + 1.0) - base)
+
+    p_raster = jnp.concatenate(
+        [p_film, jnp.zeros_like(p_film[..., :1])], axis=-1)
+    p_cam = _xform_point(cam.raster_to_camera, p_raster)
+    dx_cam = _xform_vector(
+        cam.raster_to_camera,
+        jnp.broadcast_to(jnp.asarray([1.0, 0.0, 0.0], jnp.float32),
+                         p_cam.shape),
+    )
+    dy_cam = _xform_vector(
+        cam.raster_to_camera,
+        jnp.broadcast_to(jnp.asarray([0.0, 1.0, 0.0], jnp.float32),
+                         p_cam.shape),
+    )
+    if cam.cam_type == CAM_PERSPECTIVE:
+        d0 = normalize(p_cam)
+        ddx = _xform_vector(cam.camera_to_world, normalize(p_cam + dx_cam) - d0)
+        ddy = _xform_vector(cam.camera_to_world, normalize(p_cam + dy_cam) - d0)
+        return zero, ddx, zero, ddy
+    # orthographic: direction constant, origin shifts
+    dox = _xform_vector(cam.camera_to_world, dx_cam)
+    doy = _xform_vector(cam.camera_to_world, dy_cam)
+    return dox, zero, doy, zero

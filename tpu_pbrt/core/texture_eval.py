@@ -316,8 +316,11 @@ def _compile_node(node, atlas: _AtlasBuilder) -> Callable:
             if lod is None:
                 off, w, h = levels[0]
                 return _bilinear(a, off, w, h, u, v, wrap)
-            # trilinear between the two bracketing levels (mipmap.h Lookup)
-            lodc = jnp.clip(lod, 0.0, n_levels - 1.0)
+            # `lod` carries the TEXTURE-SPACE footprint width; mipmap.h
+            # Lookup: level = nLevels - 1 + log2(max(width, eps)), then
+            # trilinear between the two bracketing levels
+            lvl = (n_levels - 1) + jnp.log2(jnp.maximum(lod, 1e-8))
+            lodc = jnp.clip(lvl, 0.0, n_levels - 1.0)
             l0 = jnp.floor(lodc).astype(jnp.int32)
             fl = lodc - l0.astype(jnp.float32)
             out0 = jnp.zeros(u.shape + (3,), jnp.float32)

@@ -236,11 +236,13 @@ class MLTIntegrator(WavefrontIntegrator):
 
         from functools import partial
 
-        @partial(jax.jit, static_argnames=("n_inner",))
-        def chain_steps(U_cur, p_cur, L_cur, y_cur, splat_img, step0, n_inner):
+        def chain_steps_body(U_cur, p_cur, L_cur, y_cur, splat_img, step0,
+                             n_inner, cid0=0):
+            n_local = U_cur.shape[0]
+
             def one(carry, step):
                 U_cur, p_cur, L_cur, y_cur, splat = carry
-                cid = jnp.arange(C, dtype=jnp.int32)
+                cid = cid0 + jnp.arange(n_local, dtype=jnp.int32)
 
                 def u(salt):
                     return uniform_float(cid, step, jnp.int32(0x3D7), salt)
@@ -286,6 +288,82 @@ class MLTIntegrator(WavefrontIntegrator):
                 step0 + jnp.arange(n_inner, dtype=jnp.int32),
             )
             return U_cur, p_cur, L_cur, y_cur, splat_img, acc.mean()
+
+        if mesh is not None and mesh.devices.size > 1:
+            # chains shard over the mesh with GLOBAL chain ids (the shard
+            # union is exactly the single-device chain set); each device
+            # splats its chains into a full-image plane that psum-merges
+            # over ICI at the end of every outer block
+            from jax.sharding import NamedSharding, PartitionSpec as PS
+
+            from tpu_pbrt.parallel.mesh import TILE_AXIS, shard_map
+
+            n_dev = int(mesh.devices.size)
+            pad_c = (-C) % n_dev
+            if pad_c:
+                U_cur = jnp.concatenate(
+                    [U_cur, jnp.repeat(U_cur[:1], pad_c, axis=0)]
+                )
+            C_tot = C + pad_c
+            cpd = C_tot // n_dev
+            U_cur = jax.device_put(
+                U_cur, NamedSharding(mesh, PS(TILE_AXIS))
+            )
+
+            _specs = dict(
+                mesh=mesh,
+                in_specs=(
+                    PS(),
+                    (PS(TILE_AXIS), PS(TILE_AXIS), PS(TILE_AXIS),
+                     PS(TILE_AXIS)),
+                    PS(),
+                    PS(),
+                ),
+                out_specs=(
+                    (PS(TILE_AXIS), PS(TILE_AXIS), PS(TILE_AXIS),
+                     PS(TILE_AXIS)),
+                    PS(),
+                    PS(),
+                ),
+                check_vma=False,
+            )
+
+            def make_steps_shard(n_inner_static):
+                def steps_shard(dev_, carry, splat_in, step0):
+                    u_, p_, l_, y_ = carry
+                    didx = jax.lax.axis_index(TILE_AXIS)
+                    u_, p_, l_, y_, delta, acc = chain_steps_body(
+                        u_, p_, l_, y_, jnp.zeros_like(splat_in), step0,
+                        n_inner_static, cid0=didx * cpd,
+                    )
+                    delta = jax.lax.psum(delta, TILE_AXIS)
+                    acc = jax.lax.pmean(acc, TILE_AXIS)
+                    return (u_, p_, l_, y_), splat_in + delta, acc
+
+                return jax.jit(shard_map(steps_shard, **_specs))
+
+            # one compiled step function per distinct n_inner (honoring
+            # the argument exactly like the single-device static arg)
+            _jit_steps_cache = {}
+
+            def chain_steps(U_c, p_c, L_c, y_c, splat_img, step0, n_inner):
+                fn = _jit_steps_cache.get(n_inner)
+                if fn is None:
+                    fn = make_steps_shard(n_inner)
+                    _jit_steps_cache[n_inner] = fn
+                carry, splat_img, acc = fn(
+                    dev, (U_c, p_c, L_c, y_c), splat_img, step0
+                )
+                return (*carry, splat_img, acc)
+
+            # padded chains are real chains (duplicated seeds) and their
+            # mutations add energy: renormalize by the true chain count
+            C = C_tot
+        else:
+            chain_steps = jax.jit(
+                partial(chain_steps_body, cid0=0),
+                static_argnames=("n_inner",),
+            )
 
         p_cur, L_cur = jax.jit(self._f)(dev, U_cur)
         y_cur = _luminance(L_cur)

@@ -42,6 +42,7 @@ from tpu_pbrt.integrators.common import (
     DIMS_PER_BOUNCE,
     WavefrontIntegrator,
     make_interaction,
+    texture_footprint,
 )
 from tpu_pbrt.scene.compiler import MAT_NONE
 
@@ -131,6 +132,41 @@ class PathIntegrator(WavefrontIntegrator):
             it.valid = it.valid & alive
             miss = alive & (hit.prim < 0)
 
+            # camera-hit ray-differential footprint -> trilinear mip
+            # selection (camera.cpp GenerateRayDifferential +
+            # interaction.cpp ComputeDifferentials); bounce>0 vertices
+            # shade at the finest level, as pbrt does for non-specular
+            # continuations
+            import os as _os
+
+            if (self.tex_eval is not None and "tri_difT" in dev
+                    and _os.environ.get("TPU_PBRT_MIPFILTER", "1") != "0"):
+                from tpu_pbrt.cameras import ray_differentials
+
+                def cam_footprint(args):
+                    o_, d_, prim_, p_, ng_, valid_ = args
+                    pf_c = jnp.stack(
+                        [px.astype(jnp.float32) + 0.5,
+                         py.astype(jnp.float32) + 0.5], axis=-1)
+                    dox, ddx, doy, ddy = ray_differentials(
+                        self.scene.camera, pf_c)
+                    w0 = texture_footprint(
+                        dev, prim_, p_, ng_, o_, d_, dox, ddx, doy, ddy
+                    )
+                    return jnp.where(valid_, w0, 0.0)
+
+                # bounce > 0 shades at the finest level (pbrt's behavior
+                # for non-specular continuations) — skip the gather +
+                # plane solves entirely on those iterations
+                width = jax.lax.cond(
+                    bounce == 0,
+                    cam_footprint,
+                    lambda args: jnp.zeros_like(args[3][..., 0]),
+                    (o, d, hit.prim, it.p, it.ng, it.valid),
+                )
+            else:
+                width = None
+
             # ---- emitted radiance with forward MIS ----------------------
             if "envmap" in dev:
                 le_env = ld.env_lookup(dev, d)
@@ -151,7 +187,7 @@ class PathIntegrator(WavefrontIntegrator):
             can_scatter = depth < self.max_depth
 
             # ---- NEE: light-sampling half only --------------------------
-            mp = self.mat_at(dev, it)
+            mp = self.mat_at(dev, it, width)
             is_null = it.valid & (mp.mtype == MAT_NONE) if self.margin else None
             u_pick = self.u1d(px, py, s, salt + DIM_LIGHT_PICK)
             u1, u2 = self.u2d(px, py, s, salt + DIM_LIGHT_UV)

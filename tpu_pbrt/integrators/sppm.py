@@ -18,12 +18,15 @@ TPU-first redesign of the two racy structures (SURVEY.md §5.2, §7 stage 8):
 - pbrt's AtomicFloat Phi[3] accumulation becomes a dense masked
   sum over the scanned run slots.
 - cross-device photon exchange (the fork's "global ray sort + photon
-  atomics" axis): the designated seam is sharding pixels AND photons
-  over the mesh and exchanging each device's deposits with
-  jax.lax.all_gather over ICI so every device gathers its own visible
-  points against the full photon set. NOT YET WIRED: render() currently
-  runs single-device (a passed mesh is ignored); see README known
-  limitations.
+  atomics" axis): pixels AND photons shard over the mesh; each device
+  traces its pixel shard's visible points and a disjoint global-id
+  range of photons, then jax.lax.all_gather over ICI replicates the
+  deposits so every device gathers its own visible points against the
+  FULL photon set. Per-pixel state stays sharded; only the deposit
+  exchange and the global max-radius (pmax) cross devices. The shard
+  union reproduces the single-device photon set exactly, so a mesh
+  render equals the single-device one up to f32 accumulation order
+  (tested on a 4-device CPU mesh).
 
 
 Capacity note: every cell run is scanned to EXHAUSTION — a while_loop
@@ -259,12 +262,15 @@ class SPPMIntegrator(WavefrontIntegrator):
     # ------------------------------------------------------------------
     # photon pass (sppm.cpp "Trace photons and accumulate contributions")
     # ------------------------------------------------------------------
-    def _photon_pass(self, dev, n_photons, it_idx):
+    def _photon_pass(self, dev, n_photons, it_idx, pid0=0):
         """Trace n_photons light subpaths; return deposit SoA of shape
         (n_photons, max_depth): position, incident direction (the photon's
         travel direction), beta, valid. Deposits skip depth 0 (direct
-        lighting is the camera pass's NEE, as in the reference)."""
-        pid = jnp.arange(n_photons, dtype=jnp.int32)
+        lighting is the camera pass's NEE, as in the reference). pid0
+        offsets the photon RNG stream ids — the mesh path gives each
+        device a disjoint global id range so the union of shards is
+        EXACTLY the single-device photon set."""
+        pid = pid0 + jnp.arange(n_photons, dtype=jnp.int32)
         py = jnp.full((n_photons,), 0x5995, jnp.int32) + it_idx
         s = jnp.full((n_photons,), it_idx, jnp.int32)
 
@@ -455,6 +461,129 @@ class SPPMIntegrator(WavefrontIntegrator):
         return phi, m, jnp.zeros((), jnp.int32)
 
     # ------------------------------------------------------------------
+    def _mesh_iteration(self, dev, mesh, state, px, py, P, n_photons):
+        """Build the sharded per-iteration step (see module doc): pixels
+        and photons shard over the mesh axis; photon deposits all_gather
+        over ICI; per-pixel state stays sharded. Returns (iteration_fn,
+        possibly padded state, total photon count)."""
+        from functools import partial
+
+        from tpu_pbrt.parallel.mesh import TILE_AXIS, shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as PS
+
+        n_dev = int(mesh.devices.size)
+        pad = (-P) % n_dev
+        if pad:
+            # padded lanes duplicate pixel 0; their state rows are
+            # dropped at develop time (render slices [:P])
+            px = jnp.concatenate([px, jnp.repeat(px[:1], pad)])
+            py = jnp.concatenate([py, jnp.repeat(py[:1], pad)])
+            state = _SPPMState(
+                r2=jnp.concatenate([state.r2, jnp.repeat(state.r2[:1], pad)]),
+                n=jnp.concatenate([state.n, jnp.zeros((pad,), jnp.float32)]),
+                tau=jnp.concatenate([state.tau, jnp.zeros((pad, 3), jnp.float32)]),
+                ld=jnp.concatenate([state.ld, jnp.zeros((pad, 3), jnp.float32)]),
+                dropped=state.dropped,
+            )
+        npd = -(-n_photons // n_dev)  # photons per device
+        n_total = npd * n_dev
+
+        shard = NamedSharding(mesh, PS(TILE_AXIS))
+        state = _SPPMState(
+            r2=jax.device_put(state.r2, shard),
+            n=jax.device_put(state.n, shard),
+            tau=jax.device_put(state.tau, shard),
+            ld=jax.device_put(state.ld, shard),
+            dropped=state.dropped,
+        )
+        px = jax.device_put(px, shard)
+        py = jax.device_put(py, shard)
+
+        # THREE separate shard_map jits, mirroring the single-device
+        # cam/photon/gather split: XLA:CPU compile time is superlinear in
+        # module size and one fused sharded module takes tens of minutes
+        # to build (the split compiles like the single-device modules)
+        sm = partial(shard_map, mesh=mesh, check_vma=False)
+
+        @jax.jit
+        @partial(
+            sm,
+            in_specs=(PS(), PS(TILE_AXIS), PS(TILE_AXIS), PS()),
+            out_specs=(PS(TILE_AXIS), PS()),
+        )
+        def cam_shard(dev_, px_s, py_s, it_idx):
+            vps, nrays = self._camera_pass(dev_, px_s, py_s, it_idx)
+            return vps, jax.lax.psum(nrays, TILE_AXIS)
+
+        @jax.jit
+        @partial(sm, in_specs=(PS(), PS()), out_specs=(PS(TILE_AXIS), PS()))
+        def photon_shard(dev_, it_idx):
+            didx = jax.lax.axis_index(TILE_AXIS)
+            dep_p, dep_d, dep_beta, dep_valid, nrays = self._photon_pass(
+                dev_, npd, it_idx, pid0=didx * npd
+            )
+            return (dep_p, dep_d, dep_beta, dep_valid), jax.lax.psum(
+                nrays, TILE_AXIS
+            )
+
+        @jax.jit
+        @partial(
+            sm,
+            in_specs=(
+                PS(),
+                (PS(TILE_AXIS),) * 4,
+                PS(TILE_AXIS),
+                (PS(TILE_AXIS),) * 4,
+            ),
+            out_specs=((PS(TILE_AXIS),) * 4, PS()),
+        )
+        def gather_shard(dev_, state_tup, vps, deps):
+            r2_s, n_s, tau_s, ld_s = state_tup
+            # ICI photon exchange: every device sees the full deposit set
+            dep_p, dep_d, dep_beta, dep_valid = (
+                jax.lax.all_gather(x, TILE_AXIS, tiled=True) for x in deps
+            )
+            # grid cell size from the GLOBAL max radius so every shard
+            # bins photons identically
+            r_max = jax.lax.pmax(jnp.sqrt(jnp.max(r2_s)), TILE_AXIS)
+            verts_lo = dev_["world_center"] - dev_["world_radius"]
+            verts_hi = dev_["world_center"] + dev_["world_radius"]
+            glo = verts_lo - r_max
+            ext = (verts_hi + r_max) - glo
+            cs = jnp.maximum(2.0 * r_max, jnp.max(ext) / 64.0)
+            gres = (64, 64, 64)
+            phi, m, dropped = self._gather(
+                dev_, vps, dep_p, dep_d, dep_beta, dep_valid, r2_s, glo,
+                cs, gres,
+            )
+            has = m > 0.0
+            n_new = n_s + _GAMMA * m
+            denom = jnp.maximum(n_s + m, 1e-20)
+            r2_new = r2_s * n_new / denom
+            tau_new = (tau_s + vps.beta * phi) * (
+                r2_new / jnp.maximum(r2_s, 1e-30)
+            )[..., None]
+            out = (
+                jnp.where(has, r2_new, r2_s),
+                jnp.where(has, n_new, n_s),
+                jnp.where(has[..., None], tau_new, tau_s),
+                ld_s + vps.ld,
+            )
+            return out, jax.lax.psum(dropped, TILE_AXIS)
+
+        def iteration(state: _SPPMState, it_idx):
+            vps, nr_c = cam_shard(dev, px, py, it_idx)
+            deps, nr_p = photon_shard(dev, it_idx)
+            tup = (state.r2, state.n, state.tau, state.ld)
+            (r2, n, tau, ld_), dropped = gather_shard(dev, tup, vps, deps)
+            return (
+                _SPPMState(r2=r2, n=n, tau=tau, ld=ld_,
+                           dropped=state.dropped + dropped),
+                nr_c + nr_p,
+            )
+
+        return iteration, state, n_total
+
     def render(self, scene=None, mesh=None, max_seconds: float = 0.0, **kw) -> RenderResult:
         scene = scene or self.scene
         dev = scene.dev
@@ -530,6 +659,11 @@ class SPPMIntegrator(WavefrontIntegrator):
             state = gather_update(state, vps, dep_p, dep_d, dep_beta, dep_valid)
             return state, nrays_c + nrays_p
 
+        if mesh is not None and mesh.devices.size > 1:
+            iteration, state, n_photons = self._mesh_iteration(
+                dev, mesh, state, px, py, P, n_photons
+            )
+
         t0 = time.time()
         rays = 0
         iters_done = 0
@@ -553,9 +687,9 @@ class SPPMIntegrator(WavefrontIntegrator):
         STATS.counter("Integrator/Rays traced", rays)
 
         ni = max(iters_done, 1)
-        ld_img = np.asarray(state.ld).reshape(h, w, 3) / ni
-        tau = np.asarray(state.tau).reshape(h, w, 3)
-        r2 = np.asarray(state.r2).reshape(h, w, 1)
+        ld_img = np.asarray(state.ld)[:P].reshape(h, w, 3) / ni
+        tau = np.asarray(state.tau)[:P].reshape(h, w, 3)
+        r2 = np.asarray(state.r2)[:P].reshape(h, w, 1)
         img = ld_img + tau / (ni * n_photons * np.pi * r2)
         img = np.ascontiguousarray(img, np.float32)
         if film.filename:

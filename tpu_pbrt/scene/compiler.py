@@ -1312,10 +1312,12 @@ def compile_scene(api) -> CompiledScene:
                 axis=1,
             ).T.copy()
         )
-    if "h_beta_m" in mtab:
-        # hair needs the shading tangent ALONG the curve: per-triangle
-        # dpdu from the uv parameterization (triangle.cpp dpdu), stored
-        # lane-major (3, T). Built only when a hair material exists.
+    if "h_beta_m" in mtab or tex_atlas is not None:
+        # uv-parameterization derivatives per triangle (triangle.cpp
+        # dpdu/dpdv): hair needs the normalized dpdu as the shading
+        # tangent; textured scenes need BOTH raw vectors for ray-
+        # differential footprints (interaction.cpp ComputeDifferentials).
+        # Stored lane-major; built only when something consumes them.
         duv02 = uvs[:, 0] - uvs[:, 2]
         duv12 = uvs[:, 1] - uvs[:, 2]
         dp02 = verts[:, 0] - verts[:, 2]
@@ -1323,14 +1325,22 @@ def compile_scene(api) -> CompiledScene:
         det = duv02[:, 0] * duv12[:, 1] - duv02[:, 1] * duv12[:, 0]
         safe = np.abs(det) > 1e-12
         inv = 1.0 / np.where(safe, det, 1.0)
-        dpdu = (
-            duv12[:, 1:2] * dp02 - duv02[:, 1:2] * dp12
-        ) * inv[:, None]
-        ln = np.linalg.norm(dpdu, axis=-1, keepdims=True)
-        dpdu = np.where(
-            safe[:, None] & (ln > 1e-12), dpdu / np.maximum(ln, 1e-20), 0.0
-        )
-        dev["tri_tanT"] = jnp.asarray(dpdu.T.copy(), jnp.float32)  # (3, T)
+        dpdu_raw = (duv12[:, 1:2] * dp02 - duv02[:, 1:2] * dp12) * inv[:, None]
+        dpdv_raw = (-duv12[:, 0:1] * dp02 + duv02[:, 0:1] * dp12) * inv[:, None]
+        dpdu_raw = np.where(safe[:, None], dpdu_raw, 0.0)
+        dpdv_raw = np.where(safe[:, None], dpdv_raw, 0.0)
+        ln = np.linalg.norm(dpdu_raw, axis=-1, keepdims=True)
+        dpdu_n = np.where(ln > 1e-12, dpdu_raw / np.maximum(ln, 1e-20), 0.0)
+        if "h_beta_m" in mtab:
+            dev["tri_tanT"] = jnp.asarray(dpdu_n.T.copy(), jnp.float32)
+        if tex_atlas is not None:
+            dev["tri_difT"] = jnp.asarray(
+                np.concatenate(
+                    [dpdu_raw.T, dpdv_raw.T, np.zeros((2, len(verts)))],
+                    axis=0,
+                ),
+                jnp.float32,
+            )  # (8, T): dpdu(3), dpdv(3), pad
     if light_rows:
         # per-light triangle vertices (area lights; zeros elsewhere) so
         # light sampling never gathers the big tri_verts array by the

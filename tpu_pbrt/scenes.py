@@ -16,12 +16,12 @@ from tpu_pbrt.scene.api import Options, PbrtAPI, parse_string, pbrt_init
 from tpu_pbrt.scene.paramset import ParamSet
 
 
-def cornell_box_text(res=256, spp=16, integrator="directlighting", maxdepth=5, filename=""):
+def cornell_box_text(res=256, spp=16, integrator="directlighting", maxdepth=5, filename="", sampler="zerotwosequence"):
     """The cornell-box config (SURVEY.md: DirectLightingIntegrator, area
     light + Lambertian). Classic Cornell geometry, meters scaled to [0,1]."""
     return f'''
 Integrator "{integrator}" "integer maxdepth" [{maxdepth}]
-Sampler "zerotwosequence" "integer pixelsamples" [{spp}]
+Sampler "{sampler}" "integer pixelsamples" [{spp}]
 PixelFilter "box"
 Film "image" "integer xresolution" [{res}] "integer yresolution" [{res}] "string filename" ["{filename}"]
 LookAt 0.5 0.5 -1.4  0.5 0.5 0  0 1 0
@@ -79,11 +79,112 @@ def compile_api(api: PbrtAPI):
     return scene, integ
 
 
-def make_cornell(res=256, spp=16, integrator="directlighting", maxdepth=5, options=None) -> PbrtAPI:
+def _crown_envmap_path():
+    """Procedural HDR sky (gradient + sun disk) written once under
+    refimg/ — the crown-class bench's environment light."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "refimg", "crown_env.pfm")
+    if os.path.exists(path):
+        return path
+    h, w = 64, 128
+    th = np.linspace(0, np.pi, h)[:, None]
+    ph = np.linspace(0, 2 * np.pi, w)[None, :]
+    sky = np.stack(
+        [
+            0.35 + 0.25 * np.cos(th) * np.ones_like(ph),
+            0.45 + 0.30 * np.cos(th) * np.ones_like(ph),
+            0.75 + 0.25 * np.cos(th) * np.ones_like(ph),
+        ],
+        axis=-1,
+    ).astype(np.float32)
+    # warm sun disk
+    sun_dir = (0.45 * np.pi, 0.3 * np.pi)
+    d2 = (th - sun_dir[0]) ** 2 + (ph - sun_dir[1]) ** 2
+    sun = np.exp(-d2 / 0.004)[..., None] * np.asarray([60.0, 50.0, 35.0])
+    img = (sky + sun).astype(np.float32)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    from tpu_pbrt.utils.imageio import write_image
+
+    write_image(path, img)
+    return path
+
+
+def make_crown_like(res=512, spp=64, maxdepth=5, options=None,
+                    n_theta=500, n_phi=1000) -> PbrtAPI:
+    """crown-class stand-in (BASELINE.md crown rows): >=1M-triangle
+    displaced mesh in GLASS, two metal-GGX side pieces, matte ground,
+    HDR environment light with 2D-CDF importance sampling — the
+    feature set of pbrt-v3-scenes/crown at a procedural geometry
+    budget (the PLYs are unavailable in this environment)."""
+    api = pbrt_init(options or Options(quiet=True))
+    env = _crown_envmap_path()
+    parse_string(
+        f"""
+Integrator "path" "integer maxdepth" [{maxdepth}]
+Sampler "zerotwosequence" "integer pixelsamples" [{spp}]
+PixelFilter "box"
+Film "image" "integer xresolution" [{res}] "integer yresolution" [{res}] "string filename" [""]
+LookAt 0 1.4 -3.6  0 0.4 0  0 1 0
+Camera "perspective" "float fov" [39]
+WorldBegin
+LightSource "infinite" "string mapname" ["{env}"]
+Material "matte" "rgb Kd" [0.45 0.42 0.38]
+Shape "trianglemesh" "integer indices" [0 1 2 0 2 3] "point P" [-8 -0.75 -8  -8 -0.75 8  8 -0.75 8  8 -0.75 -8]
+Material "glass" "float eta" [1.5] "rgb Kr" [1 1 1] "rgb Kt" [1 1 1]
+""",
+        api,
+        render=False,
+    )
+    V, F, N = _displaced_sphere(n_theta, n_phi)
+    ps = ParamSet()
+    ps.add("integer indices", F.reshape(-1).tolist())
+    ps.add("point P", V.reshape(-1).tolist())
+    ps.add("normal N", N.reshape(-1).tolist())
+    api.shape("trianglemesh", ps)
+    # two metal-GGX side pieces (rough + brushed)
+    parse_string(
+        """
+AttributeBegin
+Material "metal" "float roughness" [0.05]
+Translate -1.7 -0.15 0.4
+Scale 0.55 0.55 0.55
+""",
+        api,
+        render=False,
+    )
+    V2, F2, N2 = _displaced_sphere(140, 280, seed=11)
+    ps2 = ParamSet()
+    ps2.add("integer indices", F2.reshape(-1).tolist())
+    ps2.add("point P", V2.reshape(-1).tolist())
+    ps2.add("normal N", N2.reshape(-1).tolist())
+    api.shape("trianglemesh", ps2)
+    parse_string(
+        """
+AttributeEnd
+AttributeBegin
+Material "metal" "float roughness" [0.18] "float uroughness" [0.3] "float vroughness" [0.05]
+Translate 1.7 -0.1 0.6
+Scale 0.6 0.6 0.6
+""",
+        api,
+        render=False,
+    )
+    V3, F3, N3 = _displaced_sphere(140, 280, seed=23)
+    ps3 = ParamSet()
+    ps3.add("integer indices", F3.reshape(-1).tolist())
+    ps3.add("point P", V3.reshape(-1).tolist())
+    ps3.add("normal N", N3.reshape(-1).tolist())
+    api.shape("trianglemesh", ps3)
+    parse_string("AttributeEnd\n", api, render=False)
+    return api
+
+
+def make_cornell(res=256, spp=16, integrator="directlighting", maxdepth=5, options=None, sampler="zerotwosequence") -> PbrtAPI:
     """Parse the Cornell box up to (not including) WorldEnd, so the caller
     controls compilation/rendering via compile_api()."""
     api = pbrt_init(options or Options(quiet=True))
-    text = cornell_box_text(res, spp, integrator, maxdepth)
+    text = cornell_box_text(res, spp, integrator, maxdepth, sampler=sampler)
     text = text.rsplit("WorldEnd", 1)[0]
     parse_string(text, api, render=False)
     return api
