@@ -405,7 +405,11 @@ def test_capacity_overflow_detected_and_loud(monkeypatch):
     from tpu_pbrt.accel.stream import stream_traverse_stats
     from tpu_pbrt.scenes import compile_api, make_killeroo_like
 
-    # (a) real drops: shrink the stack headroom far below a fat wave
+    # (a) real drops: shrink the stack headroom far below a fat wave.
+    # stream_traverse_stats reads the env at TRACE time — clear its jit
+    # cache so earlier/later same-shape traces cannot leak sizes across
+    # the env flip in either direction
+    stream_traverse_stats.clear_cache()
     monkeypatch.setenv("TPU_PBRT_HEADROOM", "0.0")
     monkeypatch.setenv("TPU_PBRT_SLAB", "4096")
     api = make_killeroo_like(res=64, spp=2)
@@ -440,3 +444,4 @@ def test_capacity_overflow_detected_and_loud(monkeypatch):
     res = integ2.render(scene2)
     assert res.completed_fraction == 1.0
     monkeypatch.setattr(stream_mod, "stream_traverse_stats", real_stats)
+    stream_traverse_stats.clear_cache()

@@ -83,6 +83,47 @@ def tri_feature_weights_raw(verts: np.ndarray, center) -> np.ndarray:
     return W.astype(np.float32)
 
 
+def tri_feature_weights_motion(v0: np.ndarray, v1: np.ndarray, center,
+                               raw: bool = False) -> np.ndarray:
+    """Motion-blur feature weights: vertices lerp linearly over the
+    shutter, so every Moller-Trumbore output is a CUBIC in the ray time
+    t (det and u/v*det are quadratic, t_hit*det cubic via v0(t).n(t)).
+    The per-triangle weights become 4 monomial coefficient blocks
+    W(t) = W_0 + t W_1 + t^2 W_2 + t^3 W_3, fit EXACTLY by evaluating
+    the static weights at 4 nodes and applying the inverse Vandermonde
+    (float64). The matmul consumes the extended 64-dim ray feature
+    phi(o, d) (x) [1, t, t^2, t^3].
+
+    raw=False -> (64, 4T) matmul table; raw=True -> (T, 64, 4)."""
+    nodes = np.array([0.0, 1.0 / 3.0, 2.0 / 3.0, 1.0])
+    vand_inv = np.linalg.inv(np.vander(nodes, 4, increasing=True))  # (4,4)
+    ws = []
+    for t in nodes:
+        vt = (1.0 - t) * np.asarray(v0, np.float64) + t * np.asarray(v1, np.float64)
+        ws.append(tri_feature_weights_raw(vt, center).astype(np.float64))
+    wstack = np.stack(ws, axis=0)  # (4, T, 16, 4) values at nodes
+    coeffs = np.einsum("kn,ntfo->ktfo", vand_inv, wstack)  # (4, T, 16, 4)
+    # rows: [W0(16) | W1(16) | W2(16) | W3(16)] -> (T, 64, 4)
+    wt = np.concatenate([coeffs[k] for k in range(4)], axis=1)
+    if raw:
+        return wt.astype(np.float32)
+    T = len(wt)
+    return np.ascontiguousarray(
+        wt.transpose(1, 2, 0).reshape(64, 4 * T)
+    ).astype(np.float32)
+
+
+def ray_features_motion(o_c, d, t):
+    """phi(o, d) (x) [1, t, t^2, t^3] -> (..., 64)."""
+    phi = ray_features(o_c, d)
+    tp = jnp.stack(
+        [jnp.ones_like(t), t, t * t, t * t * t], axis=-1
+    )  # (..., 4)
+    return (tp[..., :, None] * phi[..., None, :]).reshape(
+        phi.shape[:-1] + (64,)
+    )
+
+
 def tri_feature_weights(verts: np.ndarray, center) -> np.ndarray:
     """(T,3,3) + shared center -> (16, 4T) matmul weights with column
     layout [det (T) | u*det (T) | v*det (T) | t*det (T)]."""
@@ -135,22 +176,33 @@ def decode_outputs(out, n_tris: int, t_max):
     return t_best, k, b0, b1
 
 
-def brute_feature_intersect(feat, center, n_tris: int, o, d, t_max, chunk=32768):
+def brute_feature_intersect(feat, center, n_tris: int, o, d, t_max,
+                            chunk=32768, time=None):
     """Closest hit of rays (R,3) against ALL n_tris triangles via one
     feature matmul per ray slab (the small-scene acceleration path:
-    Cornell-class scenes need no hierarchy at all on the MXU)."""
+    Cornell-class scenes need no hierarchy at all on the MXU). A
+    64-row feat table (motion blur) consumes the extended time
+    features; `time` is the per-ray shutter time in [0,1]."""
     t_max = jnp.broadcast_to(jnp.asarray(t_max, jnp.float32), o.shape[:-1])
     R = o.shape[0]
+    motion = feat.shape[0] == 64
+    if time is None:
+        time = jnp.zeros_like(t_max)
+    time = jnp.broadcast_to(jnp.asarray(time, jnp.float32), o.shape[:-1])
     n_slabs = max(1, (R + chunk - 1) // chunk)
     pad = n_slabs * chunk - R
     if pad:
         o = jnp.concatenate([o, jnp.zeros((pad, 3), o.dtype)])
         d = jnp.concatenate([d, jnp.ones((pad, 3), d.dtype)])
         t_max = jnp.concatenate([t_max, jnp.full((pad,), -1.0, t_max.dtype)])
+        time = jnp.concatenate([time, jnp.zeros((pad,), time.dtype)])
 
     def slab(args):
-        oo, dd, tt = args
-        phi = ray_features(oo - center, dd)
+        oo, dd, tt, tm = args
+        if motion:
+            phi = ray_features_motion(oo - center, dd, tm)
+        else:
+            phi = ray_features(oo - center, dd)
         out = jnp.matmul(phi, feat, precision=jax.lax.Precision.HIGHEST)
         t, k, b0, b1 = decode_outputs(out, n_tris, tt)
         prim = jnp.where(jnp.isfinite(t), k.astype(jnp.int32), -1)
@@ -162,6 +214,7 @@ def brute_feature_intersect(feat, center, n_tris: int, o, d, t_max, chunk=32768)
             o.reshape(n_slabs, chunk, 3),
             d.reshape(n_slabs, chunk, 3),
             t_max.reshape(n_slabs, chunk),
+            time.reshape(n_slabs, chunk),
         ),
     )
     flat = lambda a: a.reshape(-1)[:R]  # noqa: E731

@@ -416,7 +416,10 @@ def _flush(tp: TreeletPack, featT_tab, s: _SState, lb: int,
     # prefix — the append headroom past lb never holds countable pairs
     lb_v = min(lb, s.lf_tid.shape[0])
     b_cap = lb_v // BLOCK + C + 2
-    use_pallas = _use_pallas()
+    motion = tp.n_features == 64
+    # the Pallas leaf kernel is built for the 16-feature static layout;
+    # motion packs take the einsum path
+    use_pallas = _use_pallas() and not motion
     use_prefetch = use_pallas and _use_prefetch()
     chunk = min(CHUNK * 8 if use_prefetch else CHUNK, b_cap)
     # pack (treelet, ray) into one i32 sort key when the id ranges allow
@@ -509,6 +512,16 @@ def _flush(tp: TreeletPack, featT_tab, s: _SState, lb: int,
             + dc + oc + [jnp.ones_like(oc[0])],
             axis=1,
         )  # (CH, 16, BLOCK)
+        if motion:
+            # motion packs carry 64-row cubic-in-time features: extend
+            # phi with the per-ray shutter time powers (rayF row 7)
+            tm_r = rrows[:, 7]  # (CH, BLOCK)
+            phiT = jnp.concatenate(
+                [phiT, phiT * tm_r[:, None, :],
+                 phiT * (tm_r * tm_r)[:, None, :],
+                 phiT * (tm_r * tm_r * tm_r)[:, None, :]],
+                axis=1,
+            )  # (CH, 64, BLOCK)
         if use_prefetch:
             # full feature table stays in HBM; the kernel's scalar-prefetch
             # index_map DMAs each block's treelet row directly (no
@@ -547,7 +560,8 @@ def _flush(tp: TreeletPack, featT_tab, s: _SState, lb: int,
     )
 
 
-def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool) -> _SState:
+def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool,
+              time=None) -> _SState:
     R = o.shape[0]
     rb = _ray_bits(R)
     tb = _tn_bits(R)
@@ -564,10 +578,17 @@ def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool) -> _SState:
     featT_tab = tp.featT  # (C, 16, 4L), stored at build
 
     t_max = jnp.asarray(t_max, jnp.float32)
-    # the consolidated lane-major per-ray tables (see _SState.rayE/rayF)
+    # the consolidated lane-major per-ray tables (see _SState.rayE/rayF);
+    # rayF row 7 carries the per-ray shutter time for motion packs
+    trow = (
+        jnp.zeros((1, R), jnp.float32) if time is None
+        else jnp.broadcast_to(
+            jnp.asarray(time, jnp.float32), (R,)
+        )[None, :]
+    )
     pad1 = jnp.zeros((1, R), jnp.float32)
     rayE = jnp.concatenate([o.T, inv_d.T, t_max[None, :], pad1], axis=0)
-    rayF = jnp.concatenate([o.T, d.T, t_max[None, :], pad1], axis=0)
+    rayF = jnp.concatenate([o.T, d.T, t_max[None, :], trow], axis=0)
     alive0 = t_max > 0.0
     rid0 = jnp.arange(R, dtype=jnp.int32)
     # seed: one root pair per LIVE ray, packed exactly like _expand's
@@ -615,11 +636,13 @@ def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool) -> _SState:
     return jax.lax.while_loop(cond, body, init)
 
 
-def _finalize_hits(tri_verts, o, d, t_raw, prim) -> Hit:
+def _finalize_hits(tri_verts, o, d, t_raw, prim, time=None,
+                   tri_verts1=None) -> Hit:
     """(t, prim) -> full Hit: ONE tri_verts row fetch per ray recovers
     the winner's barycentrics (beats scattering b0/b1 per tested block
     slot during the merge), and the fetched vertices ride along in
-    Hit.tv so shading never re-gathers them."""
+    Hit.tv so shading never re-gathers them. Motion scenes lerp the
+    two keyframes at the ray's time."""
     hit = prim >= 0
     t = jnp.where(hit, t_raw, jnp.inf)
     # take from a lane-major (9, T) view: the native (T, 3, 3) layout
@@ -630,6 +653,11 @@ def _finalize_hits(tri_verts, o, d, t_raw, prim) -> Hit:
     tv = jnp.take(tv9T, jnp.maximum(prim, 0), axis=1).T.reshape(
         -1, 3, 3
     )  # (R, 3, 3)
+    if tri_verts1 is not None and time is not None:
+        tv9T1 = tri_verts1.reshape(T, 9).T
+        tv1 = jnp.take(tv9T1, jnp.maximum(prim, 0), axis=1).T.reshape(-1, 3, 3)
+        tm = jnp.asarray(time, jnp.float32).reshape(-1, 1, 1)
+        tv = (1.0 - tm) * tv + tm * tv1
     v0, v1, v2 = tv[:, 0], tv[:, 1], tv[:, 2]
     e1 = v1 - v0
     e2 = v2 - v0
@@ -646,41 +674,47 @@ def _finalize_hits(tri_verts, o, d, t_raw, prim) -> Hit:
 
 
 @jax.jit
-def stream_intersect(tp: TreeletPack, tri_verts, o, d, t_max) -> Hit:
+def stream_intersect(tp: TreeletPack, tri_verts, o, d, t_max,
+                     time=None, tri_verts1=None) -> Hit:
     """Closest hit for a flat ray batch. o, d: (R, 3); t_max scalar or
     (R,). Returns Hit with global leaf-order triangle ids (and the hit
     vertices in Hit.tv) — API-compatible with bvh_intersect /
-    wide_intersect / packet_intersect."""
+    wide_intersect / packet_intersect. time/tri_verts1: motion blur
+    (see _traverse/_finalize_hits)."""
     t_max = jnp.broadcast_to(jnp.asarray(t_max, jnp.float32), o.shape[:-1])
-    s = _traverse(tp, o, d, t_max, False)
-    return _finalize_hits(tri_verts, o, d, s.rayF[6], s.prim)
+    s = _traverse(tp, o, d, t_max, False, time=time)
+    return _finalize_hits(
+        tri_verts, o, d, s.rayF[6], s.prim, time=time, tri_verts1=tri_verts1
+    )
 
 
 @partial(jax.jit, static_argnames=("n_finalize",))
 def stream_intersect_split(tp: TreeletPack, tri_verts, o, d, t_max,
-                           n_finalize: int):
+                           n_finalize: int, time=None, tri_verts1=None):
     """Fused-wave closest hit: traverse ALL rays, but build the full Hit
     (barycentric refetch) only for the first n_finalize — the tail (the
     integrator's queued shadow rays) needs just prim>=0, and skipping
     its per-ray tri_verts row fetch saves ~9 gathered elements/ray."""
     t_max = jnp.broadcast_to(jnp.asarray(t_max, jnp.float32), o.shape[:-1])
-    s = _traverse(tp, o, d, t_max, False)
+    s = _traverse(tp, o, d, t_max, False, time=time)
     n = n_finalize
     hit = _finalize_hits(
-        tri_verts, o[:n], d[:n], s.rayF[6][:n], s.prim[:n]
+        tri_verts, o[:n], d[:n], s.rayF[6][:n], s.prim[:n],
+        time=None if time is None else time[:n],
+        tri_verts1=tri_verts1,
     )
     return hit, s.prim[n:]
 
 
-def stream_intersect_p(tp: TreeletPack, o, d, t_max):
+def stream_intersect_p(tp: TreeletPack, o, d, t_max, time=None):
     """Any-hit (shadow) predicate -> bool (R,)."""
     t_max = jnp.broadcast_to(jnp.asarray(t_max, jnp.float32), o.shape[:-1])
-    return _traverse_p(tp, o, d, t_max)
+    return _traverse_p(tp, o, d, t_max, time)
 
 
 @jax.jit
-def _traverse_p(tp: TreeletPack, o, d, t_max):
-    return _traverse(tp, o, d, t_max, True).prim >= 0
+def _traverse_p(tp: TreeletPack, o, d, t_max, time=None):
+    return _traverse(tp, o, d, t_max, True, time=time).prim >= 0
 
 
 @partial(jax.jit, static_argnames=("any_hit",))

@@ -60,6 +60,11 @@ class TreeletPack(NamedTuple):
         return self.featT.shape[2] // 4
 
     @property
+    def n_features(self) -> int:
+        """16 static, 64 with motion-blur time features."""
+        return self.featT.shape[1]
+
+    @property
     def n_treelets(self) -> int:
         return self.featT.shape[0]
 
@@ -123,11 +128,15 @@ def decode_top_leaf(code):
 
 
 def build_treelet_pack(
-    tri_verts_leaf_order: np.ndarray, bvh: BVHArrays, leaf_tris: int = LEAF_TRIS
+    tri_verts_leaf_order: np.ndarray, bvh: BVHArrays,
+    leaf_tris: int = LEAF_TRIS, tri_verts1: np.ndarray = None,
 ) -> TreeletPack:
     """Cut + features + top tree. tri_verts_leaf_order: (T,3,3) float32 in
     the SAME leaf order the BVH's prim_offset indexes (the scene compiler's
-    permuted triangle array, unpadded)."""
+    permuted triangle array, unpadded). tri_verts1 (same order): the
+    shutter-end keyframe — features become the 64-row cubic-in-time
+    tables of accel/mxu.py tri_feature_weights_motion, and the caller's
+    bvh must be built over union bounds."""
     off, cnt, bmin, bmax = cut_treelets(bvh, leaf_tris)
     c = len(off)
 
@@ -148,19 +157,43 @@ def build_treelet_pack(
     valid = np.arange(leaf_tris)[None, :] < cnt[:, None]
     tv = verts[np.clip(gidx, 0, t_total - 1)]  # (C, L, 3, 3)
     tv[~valid] = 0.0  # zero pad: det == 0, never hits
-    vmin = np.where(valid[..., None], tv.min(axis=2), np.inf).min(axis=1)
-    vmax = np.where(valid[..., None], tv.max(axis=2), -np.inf).max(axis=1)
+    if tri_verts1 is not None:
+        tv1 = np.asarray(tri_verts1, np.float32)[np.clip(gidx, 0, t_total - 1)]
+        tv1[~valid] = 0.0
+        both = np.concatenate([tv, tv1], axis=1)
+        vmin = np.where(
+            np.tile(valid, (1, 2))[..., None], both.min(axis=2), np.inf
+        ).min(axis=1)
+        vmax = np.where(
+            np.tile(valid, (1, 2))[..., None], both.max(axis=2), -np.inf
+        ).max(axis=1)
+    else:
+        vmin = np.where(valid[..., None], tv.min(axis=2), np.inf).min(axis=1)
+        vmax = np.where(valid[..., None], tv.max(axis=2), -np.inf).max(axis=1)
     center = (0.5 * (vmin + vmax)).astype(np.float32)  # (C, 3)
-    W = tri_feature_weights_raw(
-        tv.reshape(c * leaf_tris, 3, 3),
-        np.repeat(center, leaf_tris, axis=0)[:, None, :],
-    ).reshape(c, leaf_tris, 16, 4)
-    # (C, L, 16, 4) -> (C, 4, L, 16) -> (C, 4L, 16): rows grouped
-    # [det(L) | u*det(L) | v*det(L) | t*det(L)], matching decode_outputs'
-    # column order after the (..., f) x (k, f) contraction
-    feat = np.ascontiguousarray(
-        W.transpose(0, 3, 1, 2).reshape(c, 4 * leaf_tris, 16)
-    )
+    if tri_verts1 is not None:
+        from tpu_pbrt.accel.mxu import tri_feature_weights_motion
+
+        W = tri_feature_weights_motion(
+            tv.reshape(c * leaf_tris, 3, 3),
+            tv1.reshape(c * leaf_tris, 3, 3),
+            np.repeat(center, leaf_tris, axis=0)[:, None, :],
+            raw=True,
+        ).reshape(c, leaf_tris, 64, 4)
+        feat = np.ascontiguousarray(
+            W.transpose(0, 3, 1, 2).reshape(c, 4 * leaf_tris, 64)
+        )
+    else:
+        W = tri_feature_weights_raw(
+            tv.reshape(c * leaf_tris, 3, 3),
+            np.repeat(center, leaf_tris, axis=0)[:, None, :],
+        ).reshape(c, leaf_tris, 16, 4)
+        # (C, L, 16, 4) -> (C, 4, L, 16) -> (C, 4L, 16): rows grouped
+        # [det(L) | u*det(L) | v*det(L) | t*det(L)], matching
+        # decode_outputs' column order after the (...,f) x (k,f) contraction
+        feat = np.ascontiguousarray(
+            W.transpose(0, 3, 1, 2).reshape(c, 4 * leaf_tris, 16)
+        )
 
     return TreeletPack(
         top=top,

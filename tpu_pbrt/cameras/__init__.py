@@ -265,16 +265,13 @@ def ray_differentials(cam: CompiledCamera, p_film):
     p_raster = jnp.concatenate(
         [p_film, jnp.zeros_like(p_film[..., :1])], axis=-1)
     p_cam = _xform_point(cam.raster_to_camera, p_raster)
-    dx_cam = _xform_vector(
-        cam.raster_to_camera,
-        jnp.broadcast_to(jnp.asarray([1.0, 0.0, 0.0], jnp.float32),
-                         p_cam.shape),
-    )
-    dy_cam = _xform_vector(
-        cam.raster_to_camera,
-        jnp.broadcast_to(jnp.asarray([0.0, 1.0, 0.0], jnp.float32),
-                         p_cam.shape),
-    )
+    # raster steps as PROJECTED POINT DIFFERENCES (camera.cpp shifts the
+    # CameraSample by one pixel): raster_to_camera is projective, so
+    # pushing the step through the linear part alone mis-scales it
+    step_x = jnp.asarray([1.0, 0.0, 0.0], jnp.float32)
+    step_y = jnp.asarray([0.0, 1.0, 0.0], jnp.float32)
+    dx_cam = _xform_point(cam.raster_to_camera, p_raster + step_x) - p_cam
+    dy_cam = _xform_point(cam.raster_to_camera, p_raster + step_y) - p_cam
     if cam.cam_type == CAM_PERSPECTIVE:
         d0 = normalize(p_cam)
         ddx = _xform_vector(cam.camera_to_world, normalize(p_cam + dx_cam) - d0)

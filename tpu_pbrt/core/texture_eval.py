@@ -316,10 +316,16 @@ def _compile_node(node, atlas: _AtlasBuilder) -> Callable:
             if lod is None:
                 off, w, h = levels[0]
                 return _bilinear(a, off, w, h, u, v, wrap)
-            # `lod` carries the TEXTURE-SPACE footprint width; mipmap.h
-            # Lookup: level = nLevels - 1 + log2(max(width, eps)), then
-            # trilinear between the two bracketing levels
-            lvl = (n_levels - 1) + jnp.log2(jnp.maximum(lod, 1e-8))
+            # `lod` carries the SURFACE-uv footprint width; the uv
+            # mapping's su/sv scale it into texture space exactly as
+            # UVMapping2D::Map scales dstdx/dstdy before mipmap Lookup
+            # (other mappings approximate with scale 1)
+            map_scale = max(
+                abs(float(m.get("su", 1.0))), abs(float(m.get("sv", 1.0)))
+            ) if m.get("type", "uv") == "uv" else 1.0
+            lvl = (n_levels - 1) + jnp.log2(
+                jnp.maximum(lod * map_scale, 1e-8)
+            )
             lodc = jnp.clip(lvl, 0.0, n_levels - 1.0)
             l0 = jnp.floor(lodc).astype(jnp.int32)
             fl = lodc - l0.astype(jnp.float32)

@@ -64,6 +64,15 @@ class PathIntegrator(WavefrontIntegrator):
 
     def li(self, dev, o, d, px, py, s):
         shape = o.shape[:-1]
+        # motion blur: one shutter time per camera sample, fixed along
+        # the whole path (CameraSample::time); keyframes are the shutter
+        # endpoints, so the normalized time IS the sample
+        if "tri_verts1" in dev:
+            from tpu_pbrt.integrators.common import DIM_TIME
+
+            ray_time = self.u1d(px, py, s, DIM_TIME)
+        else:
+            ray_time = None
         max_iters = self.max_depth + 1 + self.margin
         # Fused-wave mode (the stream tracer's costs are per-WAVE fixed +
         # per-pair): each iteration traces [continuation; previous bounce's
@@ -120,13 +129,16 @@ class PathIntegrator(WavefrontIntegrator):
                     jnp.concatenate([d, st.sh_d]),
                     jnp.concatenate([t_max, st.sh_dist]),
                     n_cam=R,
+                    # shadow rays inherit their camera sample's time
+                    time=None if ray_time is None
+                    else jnp.concatenate([ray_time, ray_time]),
                 )
                 # settle the previous bounce's NEE with its visibility
                 vis_prev = (st.sh_dist > 0.0) & (sh_prim < 0)
                 L = L + jnp.where(vis_prev[..., None], st.ld_pend, 0.0)
                 nrays = nrays + (st.sh_dist > 0.0).astype(jnp.int32)
             else:
-                hit = scene_intersect(dev, o, d, t_max)
+                hit = scene_intersect(dev, o, d, t_max, time=ray_time)
             nrays = nrays + alive.astype(jnp.int32)
             it = make_interaction(dev, hit, o, d)
             it.valid = it.valid & alive

@@ -789,6 +789,8 @@ def compile_scene(api) -> CompiledScene:
             shape_list.append(r2)
 
     all_verts, all_normals, all_uvs = [], [], []
+    all_verts1 = []
+    any_motion = False
     all_mat, all_light = [], []
     mat_records: List = []
     mat_index: Dict[int, int] = {}
@@ -814,7 +816,16 @@ def compile_scene(api) -> CompiledScene:
             continue
         verts, normals, uvs = tess
         o2w = rec.object_to_world[0]
+        o2w1 = rec.object_to_world[1]
         wverts = o2w.apply_point(verts.reshape(-1, 3)).reshape(-1, 3, 3)
+        # shutter-end keyframe (AnimatedTransform endpoint baking: verts
+        # interpolate LINEARLY per ray time — transform.cpp's decompose+
+        # slerp differs for large rotations; documented deviation)
+        if not np.allclose(o2w.m, o2w1.m):
+            wverts1 = o2w1.apply_point(verts.reshape(-1, 3)).reshape(-1, 3, 3)
+            any_motion = True
+        else:
+            wverts1 = wverts
         if normals is not None:
             wn = o2w.apply_normal(normals.reshape(-1, 3)).reshape(-1, 3, 3)
             ln = np.linalg.norm(wn, axis=-1, keepdims=True)
@@ -832,6 +843,7 @@ def compile_scene(api) -> CompiledScene:
         base = sum(len(v) for v in all_verts)
         shape_tri_counts.append((rec, n_t))
         all_verts.append(wverts)
+        all_verts1.append(wverts1)
         all_normals.append(wn)
         all_uvs.append(uvs)
         all_mat.append(np.full(n_t, mid, np.int32))
@@ -861,8 +873,16 @@ def compile_scene(api) -> CompiledScene:
                 )
         all_light.append(lids)
 
+    # motion blur is active only when something moves AND the camera
+    # shutter is open for a nonzero interval
+    shutter = (
+        ro.camera_params.find_one_float("shutteropen", 0.0),
+        ro.camera_params.find_one_float("shutterclose", 1.0),
+    )
+    any_motion = any_motion and shutter[1] > shutter[0]
     if all_verts:
         verts = np.concatenate(all_verts).astype(np.float64)
+        verts1 = np.concatenate(all_verts1).astype(np.float64) if any_motion else None
         normals = np.concatenate(all_normals).astype(np.float32)
         uvs = np.concatenate(all_uvs).astype(np.float32)
         mat_ids = np.concatenate(all_mat)
@@ -870,6 +890,8 @@ def compile_scene(api) -> CompiledScene:
     else:
         # no geometry: a degenerate far-away triangle keeps shapes static
         verts = np.full((1, 3, 3), 1e30)
+        verts1 = None
+        any_motion = False
         normals = np.zeros((1, 3, 3), np.float32)
         normals[:, :, 2] = 1.0
         uvs = np.zeros((1, 3, 2), np.float32)
@@ -879,23 +901,30 @@ def compile_scene(api) -> CompiledScene:
 
         mat_records.append(MaterialRecord("none", {}))
 
-    # -- world bounds ----------------------------------------------------
-    finite = np.abs(verts).max(axis=(1, 2)) < 1e29
+    # -- world bounds (union over the shutter when anything moves) -------
+    vb = verts if verts1 is None else np.concatenate([verts, verts1])
+    finite = np.abs(vb).max(axis=(1, 2)) < 1e29
     if finite.any():
-        wmin = verts[finite].min(axis=(0, 1))
-        wmax = verts[finite].max(axis=(0, 1))
+        wmin = vb[finite].min(axis=(0, 1))
+        wmax = vb[finite].max(axis=(0, 1))
     else:
         wmin = np.full(3, -1.0)
         wmax = np.full(3, 1.0)
     wcenter = 0.5 * (wmin + wmax)
     wradius = float(np.linalg.norm(wmax - wcenter)) + 1e-6
 
-    # -- BVH -------------------------------------------------------------
+    # -- BVH (per-tri bounds = union over the two keyframes) -------------
     bmin, bmax = triangle_bounds(verts)
+    if verts1 is not None:
+        bmin1, bmax1 = triangle_bounds(verts1)
+        bmin = np.minimum(bmin, bmin1)
+        bmax = np.maximum(bmax, bmax1)
     bvh = build_bvh(bmin, bmax, method=ro.accelerator_params.find_one_string("splitmethod", "auto")
                     if ro.accelerator_name == "bvh" else "auto")
     order = bvh.prim_order
     verts = verts[order]
+    if verts1 is not None:
+        verts1 = verts1[order]
     normals = normals[order]
     uvs = uvs[order]
     mat_ids = mat_ids[order]
@@ -1271,6 +1300,8 @@ def compile_scene(api) -> CompiledScene:
 
     dev = {
         "tri_verts": jnp.asarray(pad_tri_verts(verts), jnp.float32),
+        **({"tri_verts1": jnp.asarray(pad_tri_verts(verts1), jnp.float32)}
+           if verts1 is not None else {}),
         "tri_normals": jnp.asarray(normals, jnp.float32),
         "tri_uvs": jnp.asarray(uvs, jnp.float32),
         "tri_mat": jnp.asarray(mat_ids, jnp.int32),
@@ -1354,6 +1385,12 @@ def compile_scene(api) -> CompiledScene:
     if light_atlas_chunks:
         dev["light_atlas"] = jnp.asarray(light_atlas, jnp.float32)
     accel_kind = _os.environ.get("TPU_PBRT_BVH", "stream")
+    if verts1 is not None and accel_kind in ("binary", "wide"):
+        Warning(
+            "motion blur is only supported on the stream/brute accel "
+            f"paths; this {accel_kind}-walker render is STATIC at "
+            "shutter start"
+        )
     if accel_kind == "binary":
         dev["bvh"] = bvh_as_device_dict(bvh)
     elif accel_kind == "wide":
@@ -1363,11 +1400,27 @@ def compile_scene(api) -> CompiledScene:
         from tpu_pbrt.accel.treelet import build_treelet_pack
 
         if len(verts) <= BRUTE_MAX_TRIS:
-            dev["bfeat"] = {
-                "feat": jnp.asarray(tri_feature_weights(verts, wcenter)),
-                "center": jnp.asarray(wcenter, jnp.float32),
-            }
+            if verts1 is not None:
+                from tpu_pbrt.accel.mxu import tri_feature_weights_motion
+
+                dev["bfeat"] = {
+                    "feat": jnp.asarray(
+                        tri_feature_weights_motion(verts, verts1, wcenter)
+                    ),
+                    "center": jnp.asarray(wcenter, jnp.float32),
+                }
+            else:
+                dev["bfeat"] = {
+                    "feat": jnp.asarray(tri_feature_weights(verts, wcenter)),
+                    "center": jnp.asarray(wcenter, jnp.float32),
+                }
         elif accel_kind == "packet":
+            if verts1 is not None:
+                Warning(
+                    "motion blur is only supported on the stream/brute "
+                    "accel paths; this packet-walker render is STATIC at "
+                    "shutter start"
+                )
             dev["tpack"] = build_treelet_pack(verts, bvh)
         else:
             from tpu_pbrt.accel.stream import STREAM_LEAF_TRIS
@@ -1375,7 +1428,9 @@ def compile_scene(api) -> CompiledScene:
             leaf_tris = int(
                 _os.environ.get("TPU_PBRT_LEAF_TRIS", STREAM_LEAF_TRIS)
             )
-            dev["tstream"] = build_treelet_pack(verts, bvh, leaf_tris=leaf_tris)
+            dev["tstream"] = build_treelet_pack(
+                verts, bvh, leaf_tris=leaf_tris, tri_verts1=verts1
+            )
     if has_envmap:
         dev["envmap"] = jnp.asarray(envmap, jnp.float32)
         dev["env_distr"] = env_distr
