@@ -34,7 +34,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 T_START = time.time()
-BUDGET = float(os.environ.get("BENCH_BUDGET_S", "420"))
+BUDGET = float(os.environ.get("BENCH_BUDGET_S", "520"))
 
 #: last completed throughput measurement, reported by the SIGTERM/exception
 #: fallback so a mid-phase kill still lands the number we already have

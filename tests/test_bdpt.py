@@ -44,3 +44,97 @@ def test_bdpt_light_tracing_splats_land():
     img = _render("bdpt", 2, only={(2, 1)})
     assert img.mean() > 1e-3, "light-tracing splats produced a black image"
     assert np.isfinite(img).all()
+
+
+def _render_env_scene(integrator, md=3, spp=96, res=16):
+    """Envmap-lit scene with a glass blocker (VERDICT r4 #10's
+    done-criterion shape): infinite-light subpaths must participate."""
+    import os
+    import tempfile
+
+    import tpu_pbrt
+    from tpu_pbrt.scenes import _crown_envmap_path
+
+    env = _crown_envmap_path()
+    scene = f"""
+Integrator "{integrator}" "integer maxdepth" [{md}]
+Sampler "zerotwosequence" "integer pixelsamples" [{spp}]
+PixelFilter "box"
+Film "image" "integer xresolution" [{res}] "integer yresolution" [{res}]
+LookAt 0 1 -4  0 0.5 0  0 1 0
+Camera "perspective" "float fov" [45]
+WorldBegin
+LightSource "infinite" "string mapname" ["{env}"]
+Material "matte" "rgb Kd" [0.6 0.55 0.5]
+Shape "trianglemesh" "integer indices" [0 1 2 0 2 3]
+  "point P" [-5 0 -5  5 0 -5  5 0 5  -5 0 5]
+Material "glass" "float eta" [1.5]
+AttributeBegin
+  Translate 0 0.8 0
+  Shape "sphere" "float radius" [0.6]
+AttributeEnd
+WorldEnd
+"""
+    with tempfile.NamedTemporaryFile("w", suffix=".pbrt", delete=False) as f:
+        f.write(scene)
+        path = f.name
+    try:
+        return np.asarray(tpu_pbrt.render_file(path).image)
+    finally:
+        os.unlink(path)
+
+
+def test_bdpt_envmap_scene_matches_path():
+    """Envmap-lit glass scene: bdpt (env via weight-1 escaped camera
+    rays, all other strategies from surface bounces) must cross-converge
+    with path — guards the env MIS contract documented in bdpt.py."""
+    p = _render_env_scene("path")
+    b = _render_env_scene("bdpt")
+    assert np.isfinite(b).all()
+    rel = abs(b.mean() - p.mean()) / p.mean()
+    assert rel < 0.08, f"bdpt {b.mean():.4f} vs path {p.mean():.4f} ({rel:.1%})"
+
+
+def _render_distant_scene(integrator, md=3, spp=96, res=16):
+    import os
+    import tempfile
+
+    import tpu_pbrt
+
+    scene = f"""
+Integrator "{integrator}" "integer maxdepth" [{md}]
+Sampler "zerotwosequence" "integer pixelsamples" [{spp}]
+PixelFilter "box"
+Film "image" "integer xresolution" [{res}] "integer yresolution" [{res}]
+LookAt 0 1 -4  0 0.5 0  0 1 0
+Camera "perspective" "float fov" [45]
+WorldBegin
+LightSource "distant" "rgb L" [3 3 2.6] "point from" [2 5 -2] "point to" [0 0 0]
+Material "matte" "rgb Kd" [0.6 0.55 0.5]
+Shape "trianglemesh" "integer indices" [0 1 2 0 2 3]
+  "point P" [-5 0 -5  5 0 -5  5 0 5  -5 0 5]
+Material "plastic" "rgb Kd" [0.3 0.1 0.1] "rgb Ks" [0.4 0.4 0.4]
+AttributeBegin
+  Translate 0 0.8 0
+  Shape "sphere" "float radius" [0.6]
+AttributeEnd
+WorldEnd
+"""
+    with tempfile.NamedTemporaryFile("w", suffix=".pbrt", delete=False) as f:
+        f.write(scene)
+        path = f.name
+    try:
+        return np.asarray(tpu_pbrt.render_file(path).image)
+    finally:
+        os.unlink(path)
+
+
+def test_bdpt_distant_subpaths_match_path():
+    """VERDICT r4 #10: distant lights source full light subpaths with
+    pbrt's planar-beam (infinite-light) densities; all strategies must
+    MIS-partition and cross-converge with path."""
+    p = _render_distant_scene("path")
+    b = _render_distant_scene("bdpt")
+    assert np.isfinite(b).all()
+    rel = abs(b.mean() - p.mean()) / p.mean()
+    assert rel < 0.08, f"bdpt {b.mean():.4f} vs path {p.mean():.4f} ({rel:.1%})"

@@ -403,11 +403,12 @@ class LeSample(NamedTuple):
 
 def sample_le(dev, light_distr, u_pick, up1, up2, ud1, ud2) -> LeSample:
     """Light::Sample_Le for BDPT/SPPM light subpaths (point.cpp:169,
-    spot.cpp:94, diffuse.cpp:124 Sample_Le), batched with masked type
-    dispatch. Distant/infinite lights are flagged unsupported (their
-    emission model needs scene-spanning disks; VERDICT r3 scope) — callers
-    zero those lanes and warn at compile time."""
+    spot.cpp:94, diffuse.cpp:124, distant.cpp:59, infinite.cpp:129
+    Sample_Le), batched with masked type dispatch. Distant/infinite
+    lights emit from the scene-spanning disk behind their direction
+    (VERDICT r4 #10)."""
     from tpu_pbrt.core.sampling import (
+        concentric_sample_disk,
         cosine_sample_hemisphere,
         uniform_sample_sphere,
     )
@@ -478,22 +479,69 @@ def sample_le(dev, light_distr, u_pick, up1, up2, ud1, ud2) -> LeSample:
     # projection.cpp Sample_Le; projection directions outside the fov
     # window carry zero and are wasted, as in the reference's cone)
     is_img = (ltype == LIGHT_GONIO) | (ltype == LIGHT_PROJECTION)
-    supported = is_pt | is_spot | is_area | is_img
+    is_distant = ltype == LIGHT_DISTANT
+    is_env = ltype == LIGHT_INFINITE
+
+    # -- distant (distant.cpp Sample_Le): ldir points TOWARD the light
+    # (compiler stores from - to), so photons travel along -ldir from a
+    # world-spanning disk offset a radius toward the light;
+    # pdf_pos = 1/(pi r^2), pdf_dir = 1 (delta direction)
+    wr = dev["world_radius"]
+    wc = dev["world_center"]
+    dx_d, dy_d = concentric_sample_disk(up1, up2)
+    v1d, v2d = coordinate_system(ldir)
+    p_disk = wc + wr * (dx_d[..., None] * v1d + dy_d[..., None] * v2d)
+    p_dist = p_disk + ldir * wr
+    pdf_pos_dist = 1.0 / (jnp.pi * wr * wr)
+
+    # -- infinite (infinite.cpp Sample_Le): direction from the envmap
+    # importance distribution (PHOTONS travel -wi), origin on the
+    # tangent disk behind that direction
+    if "envmap" in dev:
+        wi_e, pdf_e, le_e = _env_sample(dev, ud1, ud2)
+        d_env = -wi_e
+        dx_e, dy_e = concentric_sample_disk(up1, up2)
+        v1e, v2e = coordinate_system(d_env)
+        p_env = (
+            wc
+            + wr * (dx_e[..., None] * v1e + dy_e[..., None] * v2e)
+            - d_env * wr
+        )
+        pdf_dir_env = pdf_e
+        le_env_s = le_e
+    else:
+        # unreachable: the compiler builds an envmap for every
+        # LIGHT_INFINITE row; keep is_env lanes inert if it ever isn't
+        d_env = d_pt
+        p_env = jnp.broadcast_to(wc, d_pt.shape)
+        pdf_dir_env = jnp.zeros_like(ud1)
+        le_env_s = jnp.zeros_like(lL)
+    supported = is_pt | is_spot | is_area | is_img | is_distant | is_env
 
     p = jnp.where(is_area[..., None], p_a, lp)
+    p = jnp.where(is_distant[..., None], p_dist, p)
+    p = jnp.where(is_env[..., None], p_env, p)
     n = jnp.where(is_area[..., None], n_a, ldir)
+    n = jnp.where(is_distant[..., None], -ldir, n)
+    n = jnp.where(is_env[..., None], d_env, n)
     d = jnp.where(is_area[..., None], d_a, d_pt)
     d = jnp.where(is_spot[..., None], d_spot, d)
+    d = jnp.where(is_distant[..., None], -ldir, d)
+    d = jnp.where(is_env[..., None], d_env, d)
     le = jnp.where(is_spot[..., None], le_spot, lL)
+    le = jnp.where(is_env[..., None], le_env_s, le)
     if "light_atlas" in dev:
         le_img = lL * _light_map_scale(
             dev, lt, li_idx, d, ltype == LIGHT_GONIO, ltype == LIGHT_PROJECTION
         )
         le = jnp.where(is_img[..., None], le_img, le)
     pdf_pos = jnp.where(is_area, pdf_pos_a, 1.0)
+    pdf_pos = jnp.where(is_distant | is_env, pdf_pos_dist, pdf_pos)
     pdf_dir = jnp.where(is_area, pdf_dir_a, pdf_dir_pt)
     pdf_dir = jnp.where(is_spot, pdf_dir_spot, pdf_dir)
-    is_delta = is_pt | is_spot | is_img
+    pdf_dir = jnp.where(is_distant, 1.0, pdf_dir)
+    pdf_dir = jnp.where(is_env, pdf_dir_env, pdf_dir)
+    is_delta = is_pt | is_spot | is_img | is_distant
     le = jnp.where(supported[..., None], le, 0.0)
     return LeSample(li_idx, pmf, p, n, d, le, pdf_pos, pdf_dir, is_delta, supported)
 
@@ -522,6 +570,19 @@ def le_pdfs(dev, li_idx, n_emit, w):
     pdf_dir = jnp.where(is_spot, uniform_cone_pdf(cos1), pdf_dir)
     pdf_dir = jnp.where(is_area, pdf_area, pdf_dir)
     pdf_pos = jnp.where(is_area, 1.0 / jnp.maximum(area, 1e-20), 1.0)
+    # distant/infinite (distant.cpp/infinite.cpp Pdf_Le): position over
+    # the scene-spanning disk; direction delta (distant) or the env
+    # importance pdf (infinite)
+    is_distant = ltype == LIGHT_DISTANT
+    is_env = ltype == LIGHT_INFINITE
+    wr = dev["world_radius"]
+    disk_pdf = 1.0 / (jnp.pi * wr * wr)
+    pdf_pos = jnp.where(is_distant | is_env, disk_pdf, pdf_pos)
+    # distant.cpp Pdf_Le: the direction is a DELTA — pdf 0, which the
+    # BDPT MIS ratio walk remaps exactly like other delta junctions
+    pdf_dir = jnp.where(is_distant, 0.0, pdf_dir)
+    if "envmap" in dev:
+        pdf_dir = jnp.where(is_env, env_pdf(dev, -w), pdf_dir)
     return pdf_pos, pdf_dir
 
 

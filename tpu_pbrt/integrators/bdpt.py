@@ -21,9 +21,17 @@ TPU-first redesign:
   reads.
 
 Scope (checked loudly at construction):
-- light subpaths start from point/spot/area lights; distant and infinite
-  lights are not light-subpath sources (their scene-spanning emission
-  model is future work) — they contribute only via s=0 camera-path hits.
+- light subpaths start from every light type except INFINITE. DISTANT
+  lights source subpaths with pbrt's infinite-light density treatment
+  (bdpt.cpp "Correct subpath sampling densities for infinite area
+  lights" + Vertex::PdfLight's planar beam density): the parallel beam
+  reaches surfaces at the scene-disk density 1/(pi r^2) x |cos|, both
+  for vertex-1 pdf_fwd and for the MIS junction's pt.pdf_rev —
+  cross-converges with path within noise on a distant-lit scene.
+  INFINITE lights remain excluded: escaped camera rays accumulate env
+  radiance at MIS weight 1, which is unbiased exactly BECAUSE the env
+  sources no other strategy (full env-subpath MIS is future work).
+  SPPM uses BOTH as photon sources (no strategy MIS there).
 - pinhole cameras for the t=1 splat strategies; with a lens the t=1
   family is skipped (losing only those strategies' variance reduction).
 - no participating media (volpath covers medium scenes).
@@ -123,11 +131,12 @@ class BDPTIntegrator(WavefrontIntegrator):
 
         lt_types = np.asarray(scene.dev["light"]["type"])
         if ((lt_types == LIGHT_DISTANT) | (lt_types == LIGHT_INFINITE)).any():
-            _W(
-                "bdpt: distant/infinite lights are not light-subpath "
-                "sources; infinite light contributes via escaped camera "
-                "rays only, distant lights via s=1 resampling"
-            )
+            if (lt_types == LIGHT_INFINITE).any():
+                _W(
+                    "bdpt: infinite lights contribute via escaped camera "
+                    "rays and s=1 resampling only (env-subpath MIS is "
+                    "future work); distant lights source full subpaths"
+                )
 
     # ------------------------------------------------------------------
     def _walk(self, dev, path: _Path, o, d, beta, pdf_dir, alive, px, py,
@@ -285,7 +294,19 @@ class BDPTIntegrator(WavefrontIntegrator):
             uniform_float(px, py, s, _SALT_LIGHT + 4),
         )
         lpath = _Path(R, n_s)
-        l_ok = les.supported & (les.pdf_pos > 0.0) & (les.pdf_dir > 0.0)
+        from tpu_pbrt.scene.compiler import LIGHT_INFINITE as _LINF
+
+        lt_type = dev["light"]["type"][les.li_idx]
+        # INFINITE is excluded from subpaths (the s=0 escaped-ray env
+        # accumulation carries weight 1 — see module Scope note);
+        # DISTANT subpaths are enabled: the ratio walk handles their
+        # delta direction via the planar beam density below
+        l_ok = (
+            les.supported
+            & (lt_type != _LINF)
+            & (les.pdf_pos > 0.0)
+            & (les.pdf_dir > 0.0)
+        )
         lpath.set(
             0,
             p=les.p,
@@ -311,6 +332,23 @@ class BDPTIntegrator(WavefrontIntegrator):
             origin_surface=~les.is_delta,
         )
         nrays = nrays + nrays_l
+        # bdpt.cpp "Correct subpath sampling densities for infinite area
+        # lights": a delta-direction (distant) light reaches vertex 1
+        # as a PARALLEL beam — its area density is the planar disk pdf
+        # 1/(pi r^2) x |cos|, not the 1/d^2-converted direction pdf the
+        # generic walk wrote (which collapses over the huge disk offset)
+        from tpu_pbrt.scene.compiler import LIGHT_DISTANT as _LDIST0
+
+        is_dd0 = dev["light"]["type"][jnp.maximum(les.li_idx, 0)] == _LDIST0
+        wr0 = dev["world_radius"]
+        planar1 = (1.0 / (jnp.pi * wr0 * wr0)) * jnp.abs(
+            dot(lpath.ng[:, 1], les.d)
+        )
+        lpath.pdf_fwd = lpath.pdf_fwd.at[:, 1].set(
+            jnp.where(
+                is_dd0 & lpath.valid[:, 1], planar1, lpath.pdf_fwd[:, 1]
+            )
+        )
         light0_is_delta = les.is_delta
         cam_p, _cam_fwd = camera_world_frame(cam)
         cam_pb = jnp.broadcast_to(cam_p, (R, 3))
@@ -358,6 +396,21 @@ class BDPTIntegrator(WavefrontIntegrator):
                     pt_pdf_rev = _convert_density(
                         pdf_dir, qs_p, pt_p, pt_ns, pt_surface
                     )
+                    # delta-direction (distant) lights: pbrt's
+                    # Vertex::PdfLight treats them as INFINITE lights —
+                    # the density of the parallel beam at pt is the
+                    # PLANAR disk density 1/(pi r^2) (area measure, no
+                    # 1/d^2 conversion), times |cos| on surfaces. A zero
+                    # here poisons every camera-side ratio into 1 and
+                    # collapses the MIS weight to 1/#strategies.
+                    from tpu_pbrt.scene.compiler import LIGHT_DISTANT as _LD
+
+                    is_dd = dev["light"]["type"][jnp.maximum(qs_li, 0)] == _LD
+                    wr_ = dev["world_radius"]
+                    planar = 1.0 / (jnp.pi * wr_ * wr_)
+                    if pt_surface:
+                        planar = planar * jnp.abs(dot(pt_ns, wi_qp))
+                    pt_pdf_rev = jnp.where(is_dd, planar, pt_pdf_rev)
                 else:
                     wo_qs = normalize(lpath.p[:, sidx - 2] - qs_p)
                     pdf_sa = self._surface_pdf_sa(dev, lpath, sidx - 1, wo_qs, wi_qp)

@@ -116,14 +116,6 @@ class SPPMIntegrator(WavefrontIntegrator):
 
         if scene.has_null_materials:
             _W("sppm: null-interface materials are traversed as opaque")
-        lt_types = np.asarray(scene.dev["light"]["type"])
-        from tpu_pbrt.scene.compiler import LIGHT_DISTANT, LIGHT_INFINITE
-
-        if ((lt_types == LIGHT_DISTANT) | (lt_types == LIGHT_INFINITE)).any():
-            _W(
-                "sppm: distant/infinite lights are not photon sources; they "
-                "contribute via camera-pass direct lighting only"
-            )
 
     # ------------------------------------------------------------------
     # camera pass: one VP per pixel (sppm.cpp "Generate SPPM visible points")
