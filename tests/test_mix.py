@@ -1,0 +1,81 @@
+"""True MixMaterial (VERDICT r4 #7): stochastic one-sample resolution
+of the scaled BSDF union (mixmat.cpp). Oracle: Lambertian f is LINEAR
+in Kd, so mix(matte(kd1), matte(kd2), a) must converge to the SAME
+image as matte(a*kd1 + (1-a)*kd2) — an exact cross-render identity, no
+golden image needed."""
+
+import numpy as np
+
+from tests.test_render import QUAD, render_scene, scene_header
+
+_PLANE = f'''
+Shape "trianglemesh" {QUAD}
+  "point P" [-20 -1 -20  20 -1 -20  20 -1 20  -20 -1 20]
+'''
+
+
+def _mix_scene(spp=64):
+    return (
+        scene_header("path", spp=spp, extra='"integer maxdepth" [2]')
+        + '''
+WorldBegin
+LightSource "infinite" "rgb L" [1.0 1.0 1.0]
+MakeNamedMaterial "red" "string type" ["matte"] "rgb Kd" [0.8 0.1 0.1]
+MakeNamedMaterial "blue" "string type" ["matte"] "rgb Kd" [0.1 0.1 0.7]
+Material "mix" "string namedmaterial1" ["red"]
+  "string namedmaterial2" ["blue"] "rgb amount" [0.3 0.3 0.3]
+'''
+        + _PLANE
+        + "WorldEnd\n"
+    )
+
+
+def _blend_scene(spp=64):
+    # 0.3*red + 0.7*blue   (amount weights material1)
+    kd = 0.3 * np.array([0.8, 0.1, 0.1]) + 0.7 * np.array([0.1, 0.1, 0.7])
+    return (
+        scene_header("path", spp=spp, extra='"integer maxdepth" [2]')
+        + f'''
+WorldBegin
+LightSource "infinite" "rgb L" [1.0 1.0 1.0]
+Material "matte" "rgb Kd" [{kd[0]} {kd[1]} {kd[2]}]
+'''
+        + _PLANE
+        + "WorldEnd\n"
+    )
+
+
+def test_mix_matches_linear_blend_of_mattes():
+    a = np.asarray(render_scene(_mix_scene()).image)
+    b = np.asarray(render_scene(_blend_scene()).image)
+    # the floor fills the lower image half; compare there (sky rows are
+    # identical constants in both renders)
+    fa, fb = a[20:, :], b[20:, :]
+    assert abs(fa.mean() - fb.mean()) < 0.01, (fa.mean(), fb.mean())
+    # per-pixel agreement within MC noise of the stochastic selection
+    assert np.abs(fa - fb).mean() < 0.05
+
+
+def test_mix_sub_materials_both_present():
+    """amount=1 must reproduce material1 exactly; amount=0 material2 —
+    the selection degenerates to deterministic (no noise penalty)."""
+    def scene(amount):
+        return (
+            scene_header("path", spp=16, extra='"integer maxdepth" [2]')
+            + f'''
+WorldBegin
+LightSource "infinite" "rgb L" [1.0 1.0 1.0]
+MakeNamedMaterial "red" "string type" ["matte"] "rgb Kd" [0.8 0.1 0.1]
+MakeNamedMaterial "blue" "string type" ["matte"] "rgb Kd" [0.1 0.1 0.7]
+Material "mix" "string namedmaterial1" ["red"]
+  "string namedmaterial2" ["blue"] "rgb amount" [{amount} {amount} {amount}]
+'''
+            + _PLANE
+            + "WorldEnd\n"
+        )
+
+    img1 = np.asarray(render_scene(scene(1.0)).image)[20:, :]
+    img0 = np.asarray(render_scene(scene(0.0)).image)[20:, :]
+    # material1 = red-dominant, material2 = blue-dominant
+    assert img1[..., 0].mean() > 2.0 * img1[..., 2].mean()
+    assert img0[..., 2].mean() > 2.0 * img0[..., 0].mean()

@@ -103,9 +103,10 @@ def test_estimator_variance_beats_random():
     render of the 16x16 cornell cannot show this: its MSE is dominated
     by silhouette pixels whose binary-visibility integrand defeats any
     stratification — all samplers tie there, measured.)"""
-    from tpu_pbrt.core.sampling import sample_2d, set_sobol_resolution
+    from tpu_pbrt.core.sampling import sample_2d
 
-    set_sobol_resolution((64, 64))
+    # decision dims are the padded per-pixel construction — no film-grid
+    # context needed (the old module-global sobol ctx is gone, ADVICE r4)
     spp = 16
     n_pix = 1024
     pix = jnp.arange(n_pix, dtype=jnp.int32)

@@ -134,3 +134,103 @@ def test_fbm_deterministic_bounded():
     a = float(noise3(q - eps)[0])
     b = float(noise3(q + eps)[0])
     assert abs(a - b) < 0.05
+
+
+def test_ewa_anisotropic_preserves_cross_axis_detail(tmp_path):
+    """mipmap.h MIPMap::EWA semantics (VERDICT r4 #7): a footprint that
+    is wide along u but narrow along v must average along u WITHOUT
+    blurring across v. The isotropic trilinear path (scalar lod = max
+    axis) picks the coarse level and destroys the stripes; the EWA
+    filter keys the level off the MINOR axis and keeps them."""
+    from tpu_pbrt.utils.imageio import write_image
+
+    # horizontal stripes: value depends only on v (8-texel period rows)
+    img = np.zeros((64, 64, 3), np.float32)
+    img[(np.arange(64) // 8 % 2 == 0), :, :] = 1.0
+    path = tmp_path / "stripes.pfm"
+    write_image(str(path), img)
+
+    from tpu_pbrt.core.texture_eval import build_texture_table
+
+    node = (
+        "imagemap",
+        {
+            "kind": "spectrum",
+            "filename": str(path),
+            "mapping": {"type": "uv", "su": 1.0, "sv": 1.0, "du": 0.0,
+                        "dv": 0.0},
+            "trilerp": False,
+            "max_aniso": 8.0,
+            "wrap": "repeat",
+            "scale": 1.0,
+            "gamma": False,
+        },
+    )
+    atlas, ev = build_texture_table([node])
+    a = jnp.asarray(atlas)
+    # center of a white stripe (v around 0.0625 = row 4 of 64)
+    uv = jnp.asarray([[0.5, 4.5 / 64.0]], jnp.float32)
+    p = jnp.zeros((1, 3), jnp.float32)
+    tid = jnp.zeros((1,), jnp.int32)
+
+    # anisotropic footprint: wide along u, a texel along v
+    duv4 = jnp.asarray([[0.25, 0.0, 0.0, 1.0 / 64.0]], jnp.float32)
+    out_ewa = float(np.asarray(ev(a, tid, uv, p, duv4))[0, 0])
+    # isotropic path at the same MAX width (the old behavior)
+    out_iso = float(
+        np.asarray(ev(a, tid, uv, p, jnp.full((1,), 0.25, jnp.float32)))[0, 0]
+    )
+    assert out_ewa > 0.85, f"EWA blurred across the minor axis: {out_ewa}"
+    assert out_iso < 0.7, (
+        f"isotropic reference unexpectedly sharp ({out_iso}) — "
+        "the oracle no longer discriminates"
+    )
+
+
+def test_ewa_isotropic_footprint_matches_trilinear():
+    """A circular footprint must reduce EWA to (approximately) the
+    single-tap trilinear result — the taps collapse onto the same
+    ellipse and the Gaussian weights normalize out."""
+    from tpu_pbrt.core.texture_eval import build_texture_table
+
+    rng = np.random.default_rng(7)
+    # procedural checker node needs no file; use an imagemap-free
+    # comparison via a synthetic imagemap written to tmp — instead
+    # reuse fbm-free path: build a small random pfm in-memory
+    import tempfile
+
+    from tpu_pbrt.utils.imageio import write_image
+
+    img = rng.uniform(size=(32, 32, 3)).astype(np.float32)
+    with tempfile.NamedTemporaryFile(suffix=".pfm", delete=False) as f:
+        path = f.name
+    write_image(path, img)
+    node = (
+        "imagemap",
+        {
+            "kind": "spectrum",
+            "filename": path,
+            "mapping": {"type": "uv", "su": 1.0, "sv": 1.0, "du": 0.0,
+                        "dv": 0.0},
+            "trilerp": False,
+            "max_aniso": 8.0,
+            "wrap": "repeat",
+            "scale": 1.0,
+            "gamma": False,
+        },
+    )
+    atlas, ev = build_texture_table([node])
+    a = jnp.asarray(atlas)
+    n = 16
+    uv = jnp.asarray(rng.uniform(0.1, 0.9, (n, 2)), jnp.float32)
+    p = jnp.zeros((n, 3), jnp.float32)
+    tid = jnp.zeros((n,), jnp.int32)
+    w = 0.1
+    duv4 = jnp.tile(jnp.asarray([[w, 0.0, 0.0, w]], jnp.float32), (n, 1))
+    out_ewa = np.asarray(ev(a, tid, uv, p, duv4))
+    out_tri = np.asarray(ev(a, tid, uv, p, jnp.full((n,), w, jnp.float32)))
+    # same level, taps spread across one footprint width: close, not exact
+    assert np.max(np.abs(out_ewa - out_tri)) < 0.15
+    import os
+
+    os.unlink(path)

@@ -27,11 +27,17 @@ from tpu_pbrt.utils.error import Error, Warning
 CAM_PERSPECTIVE = 0
 CAM_ORTHOGRAPHIC = 1
 CAM_ENVIRONMENT = 2
+CAM_REALISTIC = 3
 
 
 class CompiledCamera(NamedTuple):
     """Device-ready camera. Matrices are float32 (4,4); row-vector math is
-    done explicitly in generate_rays."""
+    done explicitly in generate_rays. For CAM_REALISTIC, `lens` carries
+    the compiled element stack (cameras/realistic.py) and the projective
+    matrices hold a thin-lens PROXY (fov from the focused film distance)
+    used only by the pinhole-approximated seams (ray differentials,
+    BDPT t=1 / light-tracing We — pbrt's realistic camera does not
+    implement We/Sample_Wi at all; the proxy is our loud stand-in)."""
 
     cam_type: int  # static python int — selects the trace path
     raster_to_camera: jnp.ndarray  # (4,4)
@@ -41,6 +47,7 @@ class CompiledCamera(NamedTuple):
     shutter_open: float
     shutter_close: float
     full_res: tuple  # (x, y)
+    lens: object = None  # CompiledLens for CAM_REALISTIC
 
 
 def _screen_window(aspect: float, params) -> tuple:
@@ -57,28 +64,61 @@ def _screen_window(aspect: float, params) -> tuple:
     return screen
 
 
-def make_camera(name: str, params, cam_to_world: xf.Transform, full_res, shutter=(0.0, 1.0)):
+def make_camera(name: str, params, cam_to_world: xf.Transform, full_res,
+                shutter=(0.0, 1.0), film_diag: float = 0.035,
+                scene_dir: str = "."):
     """api.cpp MakeCamera: string-dispatched factory -> CompiledCamera."""
     res_x, res_y = full_res
     aspect = params.find_one_float("frameaspectratio", res_x / res_y)
     lens_radius = params.find_one_float("lensradius", 0.0)
     focal = params.find_one_float("focaldistance", 1e6)
+    lens = None
 
     if name in ("perspective", "realistic"):
         if name == "realistic":
-            Warning("realistic camera approximated by thin-lens perspective model")
-            # aperturediameter in mm; focusdistance in meters
-            lens_radius = params.find_one_float("aperturediameter", 1.0) / 1000.0 / 2.0
+            # real lens-element tracing (cameras/realistic.py). The
+            # projective matrices built below become the thin-lens PROXY
+            # for the pinhole-approximated seams (see CompiledCamera).
+            import math as _math
+
+            from tpu_pbrt.cameras.realistic import (
+                builtin_doublet,
+                compile_lens,
+                parse_lens_file,
+            )
+            from tpu_pbrt.utils.fileutil import resolve_filename
+
+            ap_diam = params.find_one_float("aperturediameter", 1.0) / 1000.0
             focal = params.find_one_float("focusdistance", 10.0)
-            fov = 45.0
+            lens_file = params.find_one_string("lensfile", "")
+            rows = None
+            if lens_file:
+                try:
+                    rows = parse_lens_file(
+                        resolve_filename(lens_file, scene_dir)
+                    )
+                except Exception as e:  # noqa: BLE001
+                    Warning(
+                        f'realistic: could not read lensfile "{lens_file}" '
+                        f"({e}); using the built-in doublet"
+                    )
+            if rows is None:
+                rows = builtin_doublet(ap_diam=max(ap_diam, 1e-4))
+            lens = compile_lens(rows, focal, film_diag)
+            ctype = CAM_REALISTIC
+            # proxy fov from the focused film distance (2 atan(diag/2z))
+            fov = _math.degrees(
+                2.0 * _math.atan(0.5 * film_diag / max(lens.rear_z, 1e-4))
+            )
+            lens_radius = ap_diam / 2.0
         else:
             fov = params.find_one_float("fov", 90.0)
             halffov = params.find_one_float("halffov", -1.0)
             if halffov > 0:
                 fov = 2.0 * halffov
+            ctype = CAM_PERSPECTIVE
         screen = _screen_window(aspect, params)
         cam_to_screen = xf.perspective(fov, 1e-2, 1000.0)
-        ctype = CAM_PERSPECTIVE
     elif name == "orthographic":
         screen = _screen_window(aspect, params)
         cam_to_screen = xf.orthographic(0.0, 1.0)
@@ -109,6 +149,7 @@ def make_camera(name: str, params, cam_to_world: xf.Transform, full_res, shutter
         shutter_open=shutter[0],
         shutter_close=shutter[1],
         full_res=(res_x, res_y),
+        lens=lens,
     )
 
 
@@ -201,6 +242,40 @@ def generate_rays(cam: CompiledCamera, p_film, u_lens):
     p_raster = jnp.concatenate([p_film, jnp.zeros_like(p_film[..., :1])], axis=-1)
     p_cam = _xform_point(cam.raster_to_camera, p_raster)
 
+    if cam.cam_type == CAM_REALISTIC:
+        # realistic.cpp GenerateRay: raster -> physical film point
+        # (x negated, pbrt's film orientation), exit-pupil sample,
+        # element-stack trace; vignetted lanes carry weight 0.
+        from tpu_pbrt.cameras.realistic import sample_pupil, trace_lenses
+
+        lens = cam.lens
+        rx, ry = cam.full_res
+        a = ry / rx
+        fx = float(np.sqrt(lens.film_diag**2 / (1.0 + a * a)))
+        fy = a * fx
+        sx = p_film[..., 0] / rx
+        sy = p_film[..., 1] / ry
+        pf = jnp.stack(
+            [-(sx - 0.5) * fx, (sy - 0.5) * fy,
+             jnp.zeros_like(sx)], axis=-1,
+        )
+        p_rear, area = sample_pupil(lens, pf, u_lens)
+        d0 = normalize(p_rear - pf)
+        ok, o_c, d_c = trace_lenses(lens, pf, d0)
+        cos4 = jnp.maximum(d0[..., 2], 0.0) ** 4
+        # exposure-normalized simple weighting (realistic.cpp's
+        # simpleWeighting, divided by the on-axis reference so a stopped
+        # -down lens meters like the thin-lens camera): cos^4 * A(r)/A(0)
+        area0 = (lens.pupil[0, 2] - lens.pupil[0, 0]) * (
+            lens.pupil[0, 3] - lens.pupil[0, 1]
+        )
+        weight = jnp.where(
+            ok, cos4 * area / jnp.maximum(area0, 1e-20), 0.0
+        )
+        o_w = _xform_point(cam.camera_to_world, o_c)
+        d_w = normalize(_xform_vector(cam.camera_to_world, d_c))
+        return o_w, d_w, weight
+
     if cam.cam_type == CAM_PERSPECTIVE:
         o = jnp.zeros_like(p_cam)
         d = normalize(p_cam)
@@ -272,7 +347,9 @@ def ray_differentials(cam: CompiledCamera, p_film):
     step_y = jnp.asarray([0.0, 1.0, 0.0], jnp.float32)
     dx_cam = _xform_point(cam.raster_to_camera, p_raster + step_x) - p_cam
     dy_cam = _xform_point(cam.raster_to_camera, p_raster + step_y) - p_cam
-    if cam.cam_type == CAM_PERSPECTIVE:
+    # realistic: the thin-lens proxy matrices stand in for the primary
+    # ray's differentials (pbrt likewise assumes the unperturbed ray)
+    if cam.cam_type in (CAM_PERSPECTIVE, CAM_REALISTIC):
         d0 = normalize(p_cam)
         ddx = _xform_vector(cam.camera_to_world, normalize(p_cam + dx_cam) - d0)
         ddy = _xform_vector(cam.camera_to_world, normalize(p_cam + dy_cam) - d0)

@@ -303,18 +303,65 @@ class MatParams(NamedTuple):
     dz: "DisneyParams | None" = None
     hz: "HairParams | None" = None
     fz: "object | None" = None  # FourierTable (core/fourierbsdf.py)
+    sub: "jnp.ndarray | None" = None  # (R,) BSSRDF table row; -1 = none
+
+
+def resolve_mix(mat: dict, mid, u):
+    """MixMaterial (mixmat.cpp) resolution: map a mix-material lane to
+    ONE of its sub-material rows with probability `amount` before the
+    parameter gather — the one-sample estimator of pbrt's scaled BSDF
+    union (f = a*f1 + (1-a)*f2). Conditioned on the pick, the lane runs
+    a standard path step under the sub-BSDF, so eval/sample/pdf and the
+    MIS weights stay mutually consistent and the outer expectation over
+    `u` reproduces the mix exactly (for scalar `amount`; colored
+    amounts select by channel mean — warned at compile).
+
+    Static no-op for mix-free scenes (the compiler only emits the
+    mix_* columns when a mix exists). Nested mixes resolve through a
+    static 4-level loop; `u` is rescaled within the picked branch so
+    the levels stay independent."""
+    if "mix_a" not in mat or u is None:
+        return mid
+    from tpu_pbrt.core.smalltab import small_take
+
+    for _ in range(4):
+        ma = small_take(mat["mix_a"], mid)
+        mb = small_take(mat["mix_b"], mid)
+        amt = small_take(mat["mix_amt"], mid)
+        is_mix = ma >= 0
+        pick_a = u < amt
+        mid = jnp.where(is_mix & pick_a, ma, jnp.where(is_mix, mb, mid))
+        u = jnp.clip(
+            jnp.where(
+                pick_a,
+                u / jnp.maximum(amt, 1e-8),
+                (u - amt) / jnp.maximum(1.0 - amt, 1e-8),
+            ),
+            0.0,
+            0.9999999,
+        )
+    return mid
 
 
 def gather_mat(mat: dict, mid) -> MatParams:
     from tpu_pbrt.core.smalltab import small_take
 
+    mtype = small_take(mat["type"], mid)
+    sub = None
+    if "sub_id" in mat:
+        # subsurface materials: the surface BSDF is EXACTLY smooth
+        # glass (Fresnel reflect + transmit — subsurface.cpp's specular
+        # interface), so lanes remap to MAT_GLASS here and the BSSRDF
+        # transport is keyed off `sub` (integrators/path.py probe wave)
+        sub = small_take(mat["sub_id"], mid)
+        mtype = jnp.where(mtype == MAT_SUBSURFACE, MAT_GLASS, mtype)
     remap = small_take(mat["remap"], mid)
     ru = small_take(mat["rough_u"], mid)
     rv = small_take(mat["rough_v"], mid)
     ax = jnp.where(remap > 0, tr_roughness_to_alpha(ru), jnp.maximum(ru, 1e-3))
     ay = jnp.where(remap > 0, tr_roughness_to_alpha(rv), jnp.maximum(rv, 1e-3))
     return MatParams(
-        mtype=small_take(mat["type"], mid),
+        mtype=mtype,
         kd=small_take(mat["kd"], mid),
         ks=small_take(mat["ks"], mid),
         kr=small_take(mat["kr"], mid),
@@ -350,6 +397,7 @@ def gather_mat(mat: dict, mid) -> MatParams:
             h=jnp.zeros_like(small_take(mat["h_beta_m"], mid)),
         ) if "h_beta_m" in mat else None,
         fz=mat.get("_fourier"),
+        sub=sub,
     )
 
 

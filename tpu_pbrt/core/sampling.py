@@ -520,20 +520,16 @@ def sobol_sample(index, dim, scramble_seed=None):
 _HALTON_PAIRS = [(2, 3), (5, 7), (3, 5), (7, 2), (2, 5), (3, 7)]
 
 
-#: render context for the true Sobol sampler: log2 of the pixel grid
-#: the global index remap covers. Set by the integrator before tracing
-#: (static at trace time; the per-scene jit cache keys re-read it).
-_SOBOL_CTX = {"m": 0}
-
-
-def set_sobol_resolution(res_xy):
-    """Configure the SobolSampler's pixel grid: the smallest 2^m x 2^m
-    grid covering the film (sobol.cpp's resolution rounding). Returns m
-    so callers can validate the 32-bit global-index range."""
+def sobol_resolution_log2(res_xy) -> int:
+    """The SobolSampler's pixel grid: the smallest 2^m x 2^m grid
+    covering the film (sobol.cpp's resolution rounding). Returns m —
+    callers hold it (it is static per scene) and pass it into the traced
+    film-dimension remap explicitly; module-global trace-time state here
+    would silently bake a stale grid into any new jit closure (ADVICE
+    r4)."""
     m = 0
     while (1 << m) < max(int(res_xy[0]), int(res_xy[1])):
         m += 1
-    _SOBOL_CTX["m"] = m
     return m
 
 

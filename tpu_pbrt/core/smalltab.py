@@ -24,11 +24,15 @@ MAX_DENSE_ROWS = 16
 
 def small_take(table, idx, max_rows: int = MAX_DENSE_ROWS):
     """`table[idx]` with a dense one-hot select when the leading dim is
-    tiny. idx may have any shape; trailing table dims broadcast."""
+    tiny. idx may have any shape; trailing table dims broadcast.
+
+    Out-of-range idx is CLAMPED to [0, n-1], matching the native
+    `table[idx]` gather's clamp mode on both paths (the one-hot compare
+    would otherwise silently return zeros for e.g. -1 sentinels)."""
     n = table.shape[0]
     if n > max_rows:
         return table[idx]
-    idx = jnp.asarray(idx)
+    idx = jnp.clip(jnp.asarray(idx), 0, n - 1)
     oh = idx[..., None] == jnp.arange(n, dtype=idx.dtype)  # (..., n)
     ohx = oh.reshape(oh.shape + (1,) * (table.ndim - 1))
     t = table.reshape((1,) * idx.ndim + table.shape)

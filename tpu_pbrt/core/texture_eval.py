@@ -19,9 +19,16 @@ compiler hands the (small, static) set of non-constant texture nodes to
 
 Lookups use bilinear filtering at an explicit mip level (default 0 —
 pbrt's no-ray-differentials path collapses to the finest level the same
-way; trilinear filtering activates when a lod is supplied). Gamma decode
-(sRGB->linear) happens once at load, as in imagemap.cpp's
-ConvertIn(gamma).
+way). When the caller supplies the (..., 4) [dudx, dvdx, dudy, dvdy]
+uv-footprint (camera hits through ray differentials), imagemaps run the
+EWA-class anisotropic filter: mip level from the minor ellipse axis,
+EWA_TAPS Gaussian-weighted trilinear taps along the major axis,
+eccentricity clamped to MAX_ANISO (mipmap.h MIPMap::EWA semantics,
+realized as fixed-tap footprint assembly — a TPU-static formulation of
+the same ellipse integral; the data-dependent ellipse-bbox loop of the
+reference would defeat XLA). A legacy scalar lod takes one trilinear
+tap. Gamma decode (sRGB->linear) happens once at load, as in
+imagemap.cpp's ConvertIn(gamma).
 
 The procedural noise is a hash-based lattice gradient noise with pbrt's
 quintic smoothstep weights and FBm/Turbulence octave accumulation
@@ -36,6 +43,12 @@ from typing import Any, Callable, List, Tuple
 
 import jax.numpy as jnp
 import numpy as np
+
+#: EWA eccentricity clamp (pbrt ImageTexture maxanisotropy default)
+MAX_ANISO = 8.0
+#: fixed Gaussian tap count along the major axis (static cost per lane;
+#: 4 matches common hardware aniso quality at 8:1 eccentricity)
+EWA_TAPS = 4
 
 # -------------------------------------------------------------------------
 # noise (texture.cpp Noise/FBm/Turbulence)
@@ -311,22 +324,8 @@ def _compile_node(node, atlas: _AtlasBuilder) -> Callable:
         wrap = d.get("wrap", "repeat")
         n_levels = len(levels)
 
-        def ev_image(a, uv, p, lod):
-            u, v = _map2d(m, uv, p)
-            if lod is None:
-                off, w, h = levels[0]
-                return _bilinear(a, off, w, h, u, v, wrap)
-            # `lod` carries the SURFACE-uv footprint width; the uv
-            # mapping's su/sv scale it into texture space exactly as
-            # UVMapping2D::Map scales dstdx/dstdy before mipmap Lookup
-            # (other mappings approximate with scale 1)
-            map_scale = max(
-                abs(float(m.get("su", 1.0))), abs(float(m.get("sv", 1.0)))
-            ) if m.get("type", "uv") == "uv" else 1.0
-            lvl = (n_levels - 1) + jnp.log2(
-                jnp.maximum(lod * map_scale, 1e-8)
-            )
-            lodc = jnp.clip(lvl, 0.0, n_levels - 1.0)
+        def trilerp(a, u, v, lodc):
+            """One trilinear tap: bilinear at floor/ceil level, lerped."""
             l0 = jnp.floor(lodc).astype(jnp.int32)
             fl = lodc - l0.astype(jnp.float32)
             out0 = jnp.zeros(u.shape + (3,), jnp.float32)
@@ -335,9 +334,70 @@ def _compile_node(node, atlas: _AtlasBuilder) -> Callable:
                 tapv = _bilinear(a, off, w, h, u, v, wrap)
                 out0 = jnp.where((l0 == li)[..., None], tapv, out0)
                 out1 = jnp.where(
-                    (jnp.minimum(l0 + 1, n_levels - 1) == li)[..., None], tapv, out1
+                    (jnp.minimum(l0 + 1, n_levels - 1) == li)[..., None],
+                    tapv, out1,
                 )
             return out0 * (1.0 - fl)[..., None] + out1 * fl[..., None]
+
+        def ev_image(a, uv, p, lod):
+            u, v = _map2d(m, uv, p)
+            if lod is None:
+                off, w, h = levels[0]
+                return _bilinear(a, off, w, h, u, v, wrap)
+            # `lod` is the (..., 4) [dudx, dvdx, dudy, dvdy] SURFACE-uv
+            # footprint; the uv mapping's su/sv scale it into texture
+            # space exactly as UVMapping2D::Map scales dstdx/dstdy
+            # before MIPMap::Lookup (other mappings approximate with
+            # scale 1). A legacy scalar `lod` (isotropic width) still
+            # takes the single-tap trilinear path.
+            if lod.ndim == u.ndim + 1:
+                # ---- EWA-class anisotropic filtering (mipmap.h EWA,
+                # realized as footprint assembly): pick the mip level
+                # from the MINOR ellipse axis and place EWA_TAPS
+                # Gaussian-weighted trilinear taps along the MAJOR
+                # axis. Fixed tap count keeps the cost static (TPU:
+                # no data-dependent ellipse-bbox loop); eccentricity
+                # clamped to MAX_ANISO exactly as pbrt widens the
+                # minor axis.
+                if m.get("type", "uv") == "uv":
+                    su = abs(float(m.get("su", 1.0)))
+                    sv = abs(float(m.get("sv", 1.0)))
+                else:
+                    su = sv = 1.0
+                dux, dvx = lod[..., 0] * su, lod[..., 1] * sv
+                duy, dvy = lod[..., 2] * su, lod[..., 3] * sv
+                l2x = dux * dux + dvx * dvx
+                l2y = duy * duy + dvy * dvy
+                x_major = l2x >= l2y
+                major = jnp.sqrt(jnp.maximum(jnp.maximum(l2x, l2y), 1e-16))
+                minor = jnp.sqrt(jnp.maximum(jnp.minimum(l2x, l2y), 0.0))
+                minor = jnp.maximum(minor, major / MAX_ANISO)
+                mu = jnp.where(x_major, dux, duy)
+                mv = jnp.where(x_major, dvx, dvy)
+                lodc = jnp.clip(
+                    (n_levels - 1)
+                    + jnp.log2(jnp.maximum(minor, 1e-8)),
+                    0.0, n_levels - 1.0,
+                )
+                acc = jnp.zeros(u.shape + (3,), jnp.float32)
+                wsum = 0.0
+                for t in range(EWA_TAPS):
+                    f = (t + 0.5) / EWA_TAPS - 0.5  # (-0.5, 0.5)
+                    # pbrt's EWA Gaussian falloff (alpha = 2) over the
+                    # normalized ellipse coordinate r = 2f
+                    wgt = float(np.exp(-2.0 * (2.0 * f) ** 2))
+                    acc = acc + wgt * trilerp(
+                        a, u + f * mu, v + f * mv, lodc
+                    )
+                    wsum += wgt
+                return acc / wsum
+            map_scale = max(
+                abs(float(m.get("su", 1.0))), abs(float(m.get("sv", 1.0)))
+            ) if m.get("type", "uv") == "uv" else 1.0
+            lvl = (n_levels - 1) + jnp.log2(
+                jnp.maximum(lod * map_scale, 1e-8)
+            )
+            return trilerp(a, u, v, jnp.clip(lvl, 0.0, n_levels - 1.0))
 
         return ev_image
     if kind == "uv":

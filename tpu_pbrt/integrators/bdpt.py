@@ -172,6 +172,13 @@ class BDPTIntegrator(WavefrontIntegrator):
                     miss[..., None], beta * ld.env_lookup(dev, d), 0.0
                 )
             pdf_area = _convert_density(pdf_dir, prev_p, it.p, it.ns, True)
+            # mix materials resolve HERE (one draw per vertex) and the
+            # RESOLVED sub-material id is what the vertex stores — every
+            # later MIS/connection eval re-gathers the same leaf row, so
+            # the whole (s,t) strategy family shades one consistent BSDF
+            mid = bxdf.resolve_mix(
+                dev["mat"], it.mat, uniform_float(px, py, s, salt + 11)
+            )
             path.set(
                 i,
                 p=jnp.where(found[..., None], it.p, 0.0),
@@ -179,13 +186,13 @@ class BDPTIntegrator(WavefrontIntegrator):
                 ns=jnp.where(found[..., None], it.ns, 0.0),
                 beta=jnp.where(found[..., None], beta, 0.0),
                 pdf_fwd=jnp.where(found, pdf_area, 0.0),
-                mat=jnp.where(found, it.mat, -1),
+                mat=jnp.where(found, mid, -1),
                 light=jnp.where(found, it.light, -1),
                 valid=found,
             )
             if k == n_steps - 1:
                 break  # the last slot never scatters
-            mp = bxdf.gather_mat(dev["mat"], it.mat)
+            mp = bxdf.gather_mat(dev["mat"], mid)
             wo_l = to_local(it.wo, it.ss, it.ts, it.ns)
             bs = bxdf.bsdf_sample(
                 mp, wo_l,

@@ -211,6 +211,7 @@ DIM_LIGHT_UV = 5
 DIM_BSDF_LOBE = 7
 DIM_BSDF_UV = 8
 DIM_RR = 10
+DIM_MIX = 11
 DIMS_PER_BOUNCE = 16
 
 
@@ -321,13 +322,15 @@ def make_interaction(dev, hit: Hit, o, d) -> Interaction:
 
 def texture_footprint(dev, it_prim, p_hit, ng, o, d, dox, ddx, doy, ddy):
     """SurfaceInteraction::ComputeDifferentials (interaction.cpp) -> the
-    isotropic texture-space footprint width for MIPMap::Lookup.
+    texture-space uv differentials for MIPMap::Lookup.
 
     Intersect the two pixel-offset rays with the tangent plane at the
     hit, take dpdx/dpdy, and solve the 2x2 least-squares for duv/dx and
     duv/dy against the triangle's uv-parameterization derivatives
-    (dev["tri_difT"], built at compile). Returns (R,) width, 0 where
-    undefined (level-0 fallback)."""
+    (dev["tri_difT"], built at compile). Returns (R, 4) stacked
+    [dudx, dvdx, dudy, dvdy], 0 where undefined (level-0 fallback) —
+    the full anisotropic footprint the EWA-class imagemap filter
+    (texture_eval.py) needs; isotropic consumers take the row max."""
     prim = jnp.maximum(it_prim, 0)
     rows = jnp.take(dev["tri_difT"], prim, axis=1)  # (8, R)
     dpdu = jnp.moveaxis(rows[0:3], 0, -1)
@@ -360,22 +363,23 @@ def texture_footprint(dev, it_prim, p_hit, ng, o, d, dox, ddx, doy, ddy):
 
     dudx, dvdx = solve(dpdx)
     dudy, dvdy = solve(dpdy)
-    w = jnp.maximum(
-        jnp.sqrt(dudx * dudx + dvdx * dvdx),
-        jnp.sqrt(dudy * dudy + dvdy * dvdy),
-    )
-    w = jnp.where(ok & jnp.isfinite(w), w, 0.0)
+    duv = jnp.stack([dudx, dvdx, dudy, dvdy], axis=-1)
+    good = (ok & jnp.all(jnp.isfinite(duv), axis=-1))[..., None]
     # clamp insane footprints (grazing angles): beyond half the texture
     # the coarsest level is right anyway
-    return jnp.minimum(w, 0.5)
+    return jnp.where(good, jnp.clip(duv, -0.5, 0.5), 0.0)
 
 
-def textured_mat(dev, mid, uv, p, tex_eval, tex_used, width=None) -> "bxdf.MatParams":
+def textured_mat(
+    dev, mid, uv, p, tex_eval, tex_used, width=None, u_mix=None
+) -> "bxdf.MatParams":
     """Material::ComputeScatteringFunctions' texture evaluation step
     (material.cpp): gather the constant-folded parameter table, then
     overwrite each slot that carries a texture id with its compiled
     evaluator's value at (uv, p). tex_used is a STATIC set — untextured
-    slots cost nothing at trace time."""
+    slots cost nothing at trace time. u_mix resolves mix-material lanes
+    to one sub-material (bxdf.resolve_mix) before the gather."""
+    mid = bxdf.resolve_mix(dev["mat"], mid, u_mix)
     mp = bxdf.gather_mat(dev["mat"], mid)
     if mp.hz is not None:
         # hair: across-width offset h = -1 + 2*v from the ribbon uv
@@ -558,18 +562,19 @@ class WavefrontIntegrator:
         self._prepare_sampler()
 
     def _prepare_sampler(self):
-        """Bind the sobol sampler's pixel-grid context for THIS scene.
-        Called at __init__ AND at the top of every render: the grid log2
-        lives in a module-level trace-time context, so it must be
-        (re)bound immediately before any trace — two integrators with
-        different film resolutions would otherwise cross-contaminate.
-        Also downgrades to the (0,2) sampler when spp * 4^m would
-        overflow the int32 global index (sobol.cpp uses 64-bit here)."""
+        """Bind the sobol sampler's pixel-grid log2 for THIS scene onto
+        the integrator (self._sobol_m — static per scene, threaded
+        explicitly into every traced body; ADVICE r4 retired the old
+        module-global context). Also downgrades to the (0,2) sampler
+        when spp * 4^m would overflow the int32 global index (sobol.cpp
+        uses 64-bit here)."""
+        self._sobol_m = 0
         if self.skind != "sobol":
             return
-        from tpu_pbrt.core.sampling import set_sobol_resolution
+        from tpu_pbrt.core.sampling import sobol_resolution_log2
 
-        m = set_sobol_resolution(self.scene.film.full_resolution)
+        m = sobol_resolution_log2(self.scene.film.full_resolution)
+        self._sobol_m = m
         if self.spp << (2 * m) >= (1 << 31):
             from tpu_pbrt.utils.error import Warning as _W
 
@@ -585,12 +590,14 @@ class WavefrontIntegrator:
     def u2d(self, px, py, s, salt):
         return sample_2d(self.skind, self.spp, px, py, s, salt)
 
-    def mat_at(self, dev, it, width=None) -> "bxdf.MatParams":
+    def mat_at(self, dev, it, width=None, u_mix=None) -> "bxdf.MatParams":
         """Textured material parameters at a surface interaction; width
-        is the optional texture-space ray-differential footprint (camera
-        hits) driving trilinear mip selection."""
+        is the optional (R, 4) texture-space ray-differential footprint
+        (camera hits) driving EWA/trilinear mip selection; u_mix the
+        optional mix-material selection draw (bxdf.resolve_mix)."""
         return textured_mat(
-            dev, it.mat, it.uv, it.p, self.tex_eval, self.tex_used, width
+            dev, it.mat, it.uv, it.p, self.tex_eval, self.tex_used, width,
+            u_mix,
         )
 
     # -- subclass hook ----------------------------------------------------
@@ -677,12 +684,11 @@ class WavefrontIntegrator:
                 # guarantees sample s of pixel p lands inside p; dims
                 # 0/1 give the in-pixel offset (sobol.cpp)
                 from tpu_pbrt.core.sampling import (
-                    _SOBOL_CTX,
                     _sobol_raw_bits,
                     sobol_interval_to_index,
                 )
 
-                m_res = _SOBOL_CTX["m"]
+                m_res = self._sobol_m
                 gi = sobol_interval_to_index(m_res, s, px, py)
                 sc = jnp.float32((1 << m_res) * 2.3283064365386963e-10)
                 gx = _sobol_raw_bits(gi, 0).astype(jnp.uint32).astype(jnp.float32) * sc

@@ -19,6 +19,7 @@ from tpu_pbrt.integrators.common import (
     scene_intersect_p,
     DIM_BSDF_LOBE,
     DIM_BSDF_UV,
+    DIM_MIX,
     DIMS_PER_BOUNCE,
     WavefrontIntegrator,
     estimate_direct,
@@ -70,7 +71,10 @@ class DirectLightingIntegrator(WavefrontIntegrator):
             le = ld.emitted_radiance(dev, jnp.where(it.valid, it.light, -1), it.wo, it.ng)
             L = L + beta * le
 
-            mp = self.mat_at(dev, it)
+            mp = self.mat_at(
+                dev, it,
+                u_mix=self.u1d(px, py, s, depth * 2000 + DIM_MIX),
+            )
             if self.strategy == "all":
                 for li_i in range(self.n_light_loop):
                     idx = jnp.full(o.shape[:-1], li_i, jnp.int32)

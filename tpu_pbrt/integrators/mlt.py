@@ -54,7 +54,7 @@ from tpu_pbrt.integrators.common import (
 )
 
 #: dims consumed per bounce: light pick + light uv2 + bsdf lobe + bsdf uv2 + rr
-_DIMS_PER_BOUNCE = 7
+_DIMS_PER_BOUNCE = 8  # [light pick/uv(3), bsdf(3), rr, mix]
 _DIMS_CAMERA = 4  # film xy + lens uv
 
 
@@ -133,7 +133,9 @@ class MLTIntegrator(WavefrontIntegrator):
                 U, (jnp.int32(0), base), (C, _DIMS_PER_BOUNCE)
             )
             scatter_ok = alive & (depth < self.max_depth)
-            mp = self.mat_at(dev, it)
+            # mix selection rides its own PSS dimension so f(U) stays
+            # a deterministic function of U (detailed balance needs it)
+            mp = self.mat_at(dev, it, u_mix=Ub[:, 7])
             wo_l = to_local(it.wo, it.ss, it.ts, it.ns)
             # NEE light-sampling half (MIS vs BSDF pdf, as in path.py)
             ls = ld.sample_one_light(
@@ -301,9 +303,12 @@ class MLTIntegrator(WavefrontIntegrator):
             n_dev = int(mesh.devices.size)
             pad_c = (-C) % n_dev
             if pad_c:
-                U_cur = jnp.concatenate(
-                    [U_cur, jnp.repeat(U_cur[:1], pad_c, axis=0)]
-                )
+                # seed pad rows from DISTINCT bootstrap states (wrap
+                # around the chain set) — duplicating chain 0 would
+                # over-represent one start state in the initial
+                # distribution (small transient bias on short runs)
+                wrap = jnp.arange(pad_c, dtype=jnp.int32) % C
+                U_cur = jnp.concatenate([U_cur, U_cur[wrap]])
             C_tot = C + pad_c
             cpd = C_tot // n_dev
             U_cur = jax.device_put(

@@ -38,6 +38,7 @@ from tpu_pbrt.integrators.common import (
     DIM_BSDF_UV,
     DIM_LIGHT_PICK,
     DIM_LIGHT_UV,
+    DIM_MIX,
     DIM_RR,
     DIMS_PER_BOUNCE,
     WavefrontIntegrator,
@@ -165,7 +166,7 @@ class PathIntegrator(WavefrontIntegrator):
                     w0 = texture_footprint(
                         dev, prim_, p_, ng_, o_, d_, dox, ddx, doy, ddy
                     )
-                    return jnp.where(valid_, w0, 0.0)
+                    return jnp.where(valid_[..., None], w0, 0.0)
 
                 # bounce > 0 shades at the finest level (pbrt's behavior
                 # for non-specular continuations) — skip the gather +
@@ -173,7 +174,9 @@ class PathIntegrator(WavefrontIntegrator):
                 width = jax.lax.cond(
                     bounce == 0,
                     cam_footprint,
-                    lambda args: jnp.zeros_like(args[3][..., 0]),
+                    lambda args: jnp.zeros(
+                        args[3].shape[:-1] + (4,), jnp.float32
+                    ),
                     (o, d, hit.prim, it.p, it.ng, it.valid),
                 )
             else:
@@ -199,7 +202,10 @@ class PathIntegrator(WavefrontIntegrator):
             can_scatter = depth < self.max_depth
 
             # ---- NEE: light-sampling half only --------------------------
-            mp = self.mat_at(dev, it, width)
+            mp = self.mat_at(
+                dev, it, width,
+                u_mix=self.u1d(px, py, s, salt + DIM_MIX),
+            )
             is_null = it.valid & (mp.mtype == MAT_NONE) if self.margin else None
             u_pick = self.u1d(px, py, s, salt + DIM_LIGHT_PICK)
             u1, u2 = self.u2d(px, py, s, salt + DIM_LIGHT_UV)
@@ -255,6 +261,184 @@ class PathIntegrator(WavefrontIntegrator):
             specular = jnp.where(cont, bs.is_specular, specular)
             depth = depth + cont.astype(jnp.int32)
             alive = cont
+
+            # ---- BSSRDF probe wave (bssrdf.cpp Sample_S/Sample_Sp,
+            # path.cpp's bssrdf block; compiled ONLY for scenes with
+            # subsurface materials). A lane whose interface sample was
+            # the specular TRANSMISSION re-emerges at an exit vertex
+            # found by a fixed-K probe chord: axis/channel MIS picks a
+            # radius from the baked diffusion CDF, the chord is
+            # intersected K times collecting same-material hits with
+            # reservoir selection, and the lane continues from the exit
+            # with the Sw directional lobe (NEE + cosine continuation
+            # inline below — the wavefront analog of pbrt's Sw-adapter
+            # BSDF at pi). Entry Fresnel rides the interface sample;
+            # f*cos/pdf of the specular transmission is 1, so beta here
+            # gains exactly Sp * nFound / Pdf_Sp then Sw*pi. -----------
+            if "bssrdf" in dev:
+                from tpu_pbrt.core.bssrdf import (
+                    pdf_sr,
+                    sample_sr,
+                    sr_eval,
+                    sw_eval,
+                )
+                from tpu_pbrt.core.sampling import cosine_sample_hemisphere
+                from tpu_pbrt.core.smalltab import small_take
+
+                tabS = dev["bssrdf"]
+                sub = jnp.maximum(mp.sub, 0)
+                sss = cont & (mp.sub >= 0) & bs.is_transmission
+                ua = self.u1d(px, py, s, salt + 12)
+                uc = self.u1d(px, py, s, salt + 13)
+                ur_ = self.u1d(px, py, s, salt + 14)
+                uphi = self.u1d(px, py, s, salt + 15)
+                # probe frame: ns axis w.p. 1/2, ss/ts each 1/4
+                ax0 = (ua < 0.5)[..., None]
+                ax1 = ((ua >= 0.5) & (ua < 0.75))[..., None]
+                vz = jnp.where(ax0, it.ns, jnp.where(ax1, it.ss, it.ts))
+                vx = jnp.where(ax0, it.ss, jnp.where(ax1, it.ts, it.ns))
+                vy = jnp.where(ax0, it.ts, jnp.where(ax1, it.ns, it.ss))
+                ch = jnp.clip((uc * 3.0).astype(jnp.int32), 0, 2)
+                r_s = sample_sr(tabS, sub, ch, ur_)
+                rmax_c = jnp.take_along_axis(
+                    tabS.r_max[sub], ch[..., None], axis=-1
+                )[..., 0]
+                l_ch = 2.0 * jnp.sqrt(jnp.maximum(rmax_c**2 - r_s**2, 0.0))
+                phi_s = 2.0 * jnp.pi * uphi
+                start = (
+                    it.p
+                    + r_s[..., None] * (
+                        jnp.cos(phi_s)[..., None] * vx
+                        + jnp.sin(phi_s)[..., None] * vy
+                    )
+                    + (0.5 * l_ch)[..., None] * vz
+                )
+                pdir = -vz
+                ok_r = sss & (r_s < rmax_c) & (l_ch > 0.0)
+
+                cur_o = start
+                t_rem = jnp.where(ok_r, l_ch, -1.0)
+                n_found = jnp.zeros(shape, jnp.int32)
+                sel_p, sel_ng, sel_ns = it.p, it.ng, it.ns
+                sel_ss, sel_ts = it.ss, it.ts
+                for k in range(4):
+                    hitk = scene_intersect(
+                        dev, cur_o, pdir, t_rem, time=ray_time
+                    )
+                    itk = make_interaction(dev, hitk, cur_o, pdir)
+                    nrays = nrays + (t_rem > 0.0).astype(jnp.int32)
+                    m_sub = small_take(
+                        dev["mat"]["sub_id"], jnp.maximum(itk.mat, 0)
+                    )
+                    matchk = itk.valid & (m_sub == sub) & ok_r
+                    n_found = n_found + matchk.astype(jnp.int32)
+                    u_res = uniform_float(px, py, s, salt + 4000 + k)
+                    takek = matchk & (
+                        u_res * n_found.astype(jnp.float32) < 1.0
+                    )
+                    tk = takek[..., None]
+                    sel_p = jnp.where(tk, itk.p, sel_p)
+                    sel_ng = jnp.where(tk, itk.ng, sel_ng)
+                    sel_ns = jnp.where(tk, itk.ns, sel_ns)
+                    sel_ss = jnp.where(tk, itk.ss, sel_ss)
+                    sel_ts = jnp.where(tk, itk.ts, sel_ts)
+                    adv = jnp.where(itk.valid, hitk.t + 1e-4, jnp.inf)
+                    cur_o = cur_o + adv[..., None] * pdir
+                    t_rem = jnp.where(itk.valid, t_rem - adv, -1.0)
+
+                ok_exit = ok_r & (n_found > 0)
+                dvec = sel_p - it.p
+                dist_s = jnp.linalg.norm(dvec, axis=-1)
+                sp = sr_eval(tabS, sub, dist_s)  # (R, 3)
+                # Pdf_Sp: MIS over the 3 axes x 3 channels of projected
+                # radii (bssrdf.cpp Pdf_Sp)
+                dl = jnp.stack(
+                    [dot(dvec, it.ss), dot(dvec, it.ts), dot(dvec, it.ns)],
+                    axis=-1,
+                )
+                nl = jnp.stack(
+                    [dot(sel_ns, it.ss), dot(sel_ns, it.ts),
+                     dot(sel_ns, it.ns)], axis=-1,
+                )
+                rproj = jnp.stack(
+                    [
+                        jnp.sqrt(dl[..., 1] ** 2 + dl[..., 2] ** 2),
+                        jnp.sqrt(dl[..., 2] ** 2 + dl[..., 0] ** 2),
+                        jnp.sqrt(dl[..., 0] ** 2 + dl[..., 1] ** 2),
+                    ],
+                    axis=-1,
+                )
+                ax_prob = (0.25, 0.25, 0.5)
+                pdf_tot = jnp.zeros(shape, jnp.float32)
+                for a in range(3):
+                    for c in range(3):
+                        pdf_tot = pdf_tot + pdf_sr(
+                            tabS, sub, jnp.full_like(ch, c), rproj[..., a]
+                        ) * jnp.abs(nl[..., a]) * (ax_prob[a] / 3.0)
+                ok_exit = ok_exit & (pdf_tot > 0.0) & (
+                    jnp.max(sp, axis=-1) > 0.0
+                )
+                w_sss = sp * (
+                    n_found.astype(jnp.float32)
+                    / jnp.maximum(pdf_tot, 1e-20)
+                )[..., None]
+                beta = jnp.where(ok_exit[..., None], beta * w_sss, beta)
+
+                # exit-vertex NEE with the Sw lobe (pbrt's Sw adapter)
+                eta_sub = tabS.eta[sub]
+                ls2 = ld.sample_one_light(
+                    dev, self.light_distr, sel_p,
+                    uniform_float(px, py, s, salt + 4100),
+                    uniform_float(px, py, s, salt + 4101),
+                    uniform_float(px, py, s, salt + 4102),
+                )
+                cos_l = dot(ls2.wi, sel_ns)
+                f_sw_l = sw_eval(eta_sub, cos_l) * jnp.maximum(cos_l, 0.0)
+                do2 = (
+                    ok_exit & can_scatter & (ls2.pdf > 0.0) & (cos_l > 1e-6)
+                    & (jnp.max(ls2.li, axis=-1) > 0.0)
+                )
+                occ2 = scene_intersect_p(
+                    dev, offset_ray_origin(sel_p, sel_ng, ls2.wi), ls2.wi,
+                    jnp.where(do2, ls2.dist * 0.999, -1.0),
+                )
+                nrays = nrays + do2.astype(jnp.int32)
+                w_l2 = jnp.where(
+                    ls2.is_delta, 1.0,
+                    power_heuristic(1.0, ls2.pdf, 1.0, cos_l / jnp.pi),
+                )
+                L = L + jnp.where(
+                    (do2 & ~occ2)[..., None],
+                    beta * f_sw_l[..., None] * ls2.li
+                    * (w_l2 / jnp.maximum(ls2.pdf, 1e-20))[..., None],
+                    0.0,
+                )
+
+                # cosine continuation from the exit with Sw weighting:
+                # beta *= Sw * cos / (cos/pi) = Sw * pi
+                wloc = cosine_sample_hemisphere(
+                    uniform_float(px, py, s, salt + 4103),
+                    uniform_float(px, py, s, salt + 4104),
+                )
+                wi2 = normalize(
+                    wloc[..., 0:1] * sel_ss + wloc[..., 1:2] * sel_ts
+                    + wloc[..., 2:3] * sel_ns
+                )
+                cos2 = jnp.maximum(dot(wi2, sel_ns), 1e-6)
+                beta = jnp.where(
+                    ok_exit[..., None],
+                    beta * (sw_eval(eta_sub, cos2) * jnp.pi)[..., None],
+                    beta,
+                )
+                o = jnp.where(
+                    ok_exit[..., None],
+                    offset_ray_origin(sel_p, sel_ng, wi2), o,
+                )
+                d = jnp.where(ok_exit[..., None], wi2, d)
+                prev_p = jnp.where(ok_exit[..., None], sel_p, prev_p)
+                prev_pdf = jnp.where(ok_exit, cos2 / jnp.pi, prev_pdf)
+                specular = specular & ~ok_exit
+                alive = jnp.where(sss, ok_exit, alive)
 
             # ---- null passthrough (uncounted bounce, path.cpp bounces--)
             if is_null is not None:

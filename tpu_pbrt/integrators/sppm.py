@@ -59,6 +59,7 @@ from tpu_pbrt.core.vecmath import (
 )
 from tpu_pbrt.integrators.common import (
     DIM_LENS,
+    DIM_MIX,
     DIMS_PER_BOUNCE,
     RenderResult,
     WavefrontIntegrator,
@@ -181,7 +182,9 @@ class SPPMIntegrator(WavefrontIntegrator):
             ld_acc = ld_acc + jnp.where(
                 (found & specular)[..., None], beta * le, 0.0
             )
-            mp = self.mat_at(dev, it)
+            mp = self.mat_at(
+                dev, it, u_mix=uniform_float(px, py, s, salt + DIM_MIX)
+            )
             # direct lighting at every real vertex (sppm.cpp accumulates
             # UniformSampleOneLight into pixel.Ld)
             it_masked = Interaction(
@@ -306,7 +309,7 @@ class SPPMIntegrator(WavefrontIntegrator):
             dep_valid = jax.lax.dynamic_update_index_in_dim(
                 dep_valid, dep_found, depth, 1
             )
-            mp = self.mat_at(dev, it)
+            mp = self.mat_at(dev, it, u_mix=u(salt + DIM_MIX))
             wo_l = to_local(it.wo, it.ss, it.ts, it.ns)
             bs = bxdf.bsdf_sample(mp, wo_l, u(salt + 7), u(salt + 8), u(salt + 9))
             wi_w = normalize(to_world(bs.wi, it.ss, it.ts, it.ns))

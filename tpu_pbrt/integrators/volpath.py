@@ -33,6 +33,7 @@ from tpu_pbrt.integrators.common import (
     unoccluded_tr,
     DIM_BSDF_LOBE,
     DIM_BSDF_UV,
+    DIM_MIX,
     DIM_LIGHT_PICK,
     DIM_LIGHT_UV,
     DIM_RR,
@@ -104,7 +105,10 @@ class VolPathIntegrator(WavefrontIntegrator):
                 break
 
             # ---- null material passthrough (medium transition) ----------
-            mp = self.mat_at(dev, it)
+            mp = self.mat_at(
+                dev, it,
+                u_mix=self.u1d(px, py, s, salt + DIM_MIX),
+            )
             is_null = at_surface & (mp.mtype == MAT_NONE)
             going_in_null = dot(d, it.ng) < 0.0
             med_in = dev["tri_med_in"][jnp.maximum(hit.prim, 0)]

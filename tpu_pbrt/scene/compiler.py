@@ -28,7 +28,7 @@ from __future__ import annotations
 import math
 import os
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -507,10 +507,50 @@ def _hair_sigma_a_from_reflectance(c, beta_n):
     return (np.log(np.maximum(np.asarray(c, np.float64), 1e-4)) / denom) ** 2
 
 
+#: classic measured subsurface media (Jensen, Marschner, Levoy &
+#: Hanrahan, "A Practical Model for Subsurface Light Transport",
+#: SIGGRAPH 2001, table 1): name -> (sigma_prime_s, sigma_a) in 1/mm —
+#: the most-used rows of pbrt's GetMediumScatteringProperties catalog
+#: (src/core/medium.cpp). Others fall back to explicit parameters.
+_SSS_PRESETS = {
+    "Skimmilk": ([0.70, 1.22, 1.90], [0.0014, 0.0025, 0.0142]),
+    "Wholemilk": ([2.55, 3.21, 3.77], [0.0011, 0.0024, 0.014]),
+    "Skin1": ([0.74, 0.88, 1.01], [0.032, 0.17, 0.48]),
+    "Skin2": ([1.09, 1.59, 1.79], [0.013, 0.070, 0.145]),
+    "Marble": ([2.19, 2.62, 3.00], [0.0021, 0.0041, 0.0071]),
+    "Ketchup": ([0.18, 0.07, 0.03], [0.061, 0.97, 1.45]),
+    "Cream": ([7.38, 5.47, 3.15], [0.0002, 0.0028, 0.0163]),
+    "Spectralon": ([11.6, 20.4, 14.9], [0.00, 0.00, 0.00]),
+}
+
+
 def lower_materials(mat_records: List, tex_registry,
                     scene_dir: str = ".") -> Dict[str, np.ndarray]:
     """MaterialRecords -> SoA table. tex_registry assigns ids to
-    non-constant textures (returns -1 for constants)."""
+    non-constant textures (returns -1 for constants).
+
+    Mix materials (mixmat.cpp) expand here: each mix row's two
+    sub-materials are appended as REAL rows of the same table and the
+    mix row records (mix_a, mix_b, mix_amt). Shading resolves a mix
+    lane to ONE sub-row by a sampler draw before the parameter gather
+    (bxdf.resolve_mix) — the one-sample estimator of the scaled BSDF
+    union, exact for scalar `amount` (see resolve_mix docstring).
+    Nested mixes expand recursively (resolution loops a static 4 deep)."""
+    mat_records = list(mat_records)
+    mix_sub: Dict[int, Tuple[int, int]] = {}
+    i_scan = 0
+    while i_scan < len(mat_records):
+        rec = mat_records[i_scan]
+        if rec.type == "mix":
+            m1 = rec.params.get("material1")
+            m2 = rec.params.get("material2")
+            if m1 is not None and m2 is not None:
+                ia = len(mat_records)
+                mat_records.append(m1)
+                ib = len(mat_records)
+                mat_records.append(m2)
+                mix_sub[i_scan] = (ia, ib)
+        i_scan += 1
     m = len(mat_records)
     tab = {
         "type": np.zeros(m, np.int32),
@@ -525,6 +565,10 @@ def lower_materials(mat_records: List, tex_registry,
         "sigma": np.zeros(m, np.float32),
         "opacity": np.ones((m, 3), np.float32),
         "remap": np.ones(m, np.int32),
+        "mix_a": np.full(m, -1, np.int32),
+        "mix_b": np.full(m, -1, np.int32),
+        "mix_amt": np.full(m, 0.5, np.float32),
+        "sub_id": np.full(m, -1, np.int32),
         "kd_tex": np.full(m, -1, np.int32),
         "ks_tex": np.full(m, -1, np.int32),
         "sigma_tex": np.full(m, -1, np.int32),
@@ -532,6 +576,10 @@ def lower_materials(mat_records: List, tex_registry,
         "opacity_tex": np.full(m, -1, np.int32),
         "bump_tex": np.full(m, -1, np.int32),
     }
+
+    #: (sigma_s, sigma_a, g, eta) per subsurface material, in sub_id
+    #: order; compile_scene bakes these into the device BSSRDF table
+    sss_rows: List[tuple] = []
 
     def fold_spec(rec, key, default, slot, tex_slot=None, i=0):
         node = rec.params.get(key)
@@ -717,27 +765,107 @@ def lower_materials(mat_records: List, tex_registry,
                 tab["type"][i] = MAT_MATTE
             tab["kd"][i] = 0.5
         elif t in ("subsurface", "kdsubsurface"):
-            # no BSSRDF transport yet: SUBSTITUTED by a diffuse surface
-            Warning(
-                f'material "{t}" has no BSSRDF transport in this build; '
-                "SUBSTITUTING a diffuse surface BSDF (subsurface "
-                "scattering will be missing)"
-            )
-            fold_spec(rec, "Kd" if p.get("Kd") is not None else "color", 0.5, "kd", "kd_tex", i)
+            # real BSSRDF transport (core/bssrdf.py): the surface BSDF
+            # is the smooth Fresnel interface (glass kr/kt — gather_mat
+            # remaps the type); the medium's beam-diffusion profile is
+            # baked per channel below and the path integrator runs the
+            # Sample_Sp probe wave (subsurface.cpp / bssrdf.cpp)
+            fold_spec(rec, "Kr", 1.0, "kr", None, i)
+            fold_spec(rec, "Kt", 1.0, "kt", None, i)
             fold_f(rec, "eta", 1.33, "eta", None, i)
             tab["eta"][i] = tab["eta"][i][:1].repeat(3)
+            eta_v = float(tab["eta"][i][0])
+            g_v = 0.0
+            if t == "subsurface":
+                g_v = float(_fold_const(p.get("g"), 0.0)[0])
+                preset = str(p.get("preset") or "")
+                if preset and preset in _SSS_PRESETS:
+                    sig_sp, sig_a = (
+                        np.asarray(v, np.float64)
+                        for v in _SSS_PRESETS[preset]
+                    )
+                elif preset:
+                    Warning(
+                        f'subsurface: unknown medium preset "{preset}"; '
+                        "using the sigma_a/sigma_prime_s parameters"
+                    )
+                    preset = ""
+                if not preset:
+                    sa, fold_a = _fold_const(
+                        p.get("sigma_a"), np.array([0.0011, 0.0024, 0.014])
+                    )
+                    ss_, fold_s = _fold_const(
+                        p.get("sigma_s"), np.array([2.55, 3.21, 3.77])
+                    )
+                    if not (fold_a and fold_s):
+                        Warning(
+                            "subsurface: textured sigma_a/sigma_prime_s "
+                            "are not supported (the diffusion profile "
+                            "bakes per material); using constants"
+                        )
+                    sig_a = _rgb(sa).astype(np.float64)
+                    sig_sp = _rgb(ss_).astype(np.float64)
+                scale = float(_fold_const(p.get("scale"), 1.0)[0])
+                sig_a = sig_a * scale
+                sigma_s = sig_sp * scale / max(1.0 - g_v, 1e-3)
+            else:
+                from tpu_pbrt.core.bssrdf import subsurface_from_diffuse
+
+                kd_v, _ = _fold_const(p.get("Kd"), 0.5)
+                mfp_v, _ = _fold_const(p.get("mfp"), 1.0)
+                sigma_s, sig_a = subsurface_from_diffuse(
+                    _rgb(kd_v), _rgb(mfp_v), g_v, eta_v
+                )
+            ur, _ = _fold_const(p.get("uroughness"), 0.0)
+            if np.max(np.asarray(ur, np.float64)) > 0:
+                Warning(
+                    "subsurface: rough interface not supported; using "
+                    "the smooth specular interface"
+                )
+            tab["sub_id"][i] = len(sss_rows)
+            sss_rows.append((sigma_s, sig_a, g_v, eta_v))
+            # fallback albedo for integrators without the probe wave
+            # (bdpt/sppm/mlt shade the interface only — warned at render)
+            tab["kd"][i] = 0.5
         elif t == "mix":
-            # lower to the first material's model blended by constant amount
-            amt, _ = _fold_const(p.get("amount"), 0.5)
-            Warning("mix material lowered to linear blend of sub-material diffuse")
+            # true MixMaterial (mixmat.cpp): sub-materials are rows
+            # ia/ib of this same table (expanded in the pre-pass);
+            # shading resolves the lane stochastically by `amount`
+            # before the gather (bxdf.resolve_mix). The row's own
+            # shading params are a diffuse blend FALLBACK used only
+            # past the static nesting-depth limit.
+            amt, folded = _fold_const(p.get("amount"), 0.5)
+            a = _rgb(amt)
+            if not folded:
+                Warning(
+                    "mix: textured `amount` is not supported; using "
+                    "its constant fallback for the selection probability"
+                )
+            if a.min() != a.max():
+                Warning(
+                    "mix: colored `amount` selects by its channel MEAN "
+                    "(per-channel mix weights are approximated)"
+                )
+            if i in mix_sub:
+                ia, ib = mix_sub[i]
+                tab["mix_a"][i] = ia
+                tab["mix_b"][i] = ib
+                tab["mix_amt"][i] = float(np.clip(a.mean(), 0.0, 1.0))
             tab["type"][i] = MAT_MATTE
             m1 = p.get("material1")
             m2 = p.get("material2")
             kd1, _ = _fold_const(m1.params.get("Kd") if m1 else None, 0.5)
             kd2, _ = _fold_const(m2.params.get("Kd") if m2 else None, 0.5)
-            a = _rgb(amt)
             tab["kd"][i] = _rgb(kd1) * a + _rgb(kd2) * (1 - a)
         # "none" keeps zeros (passthrough)
+    if not (tab["mix_a"] >= 0).any():
+        # mix-free scene: drop the columns so resolve_mix is a static
+        # no-op in every traced program (key presence IS the flag)
+        del tab["mix_a"], tab["mix_b"], tab["mix_amt"]
+    if sss_rows:
+        tab["_sss_rows"] = sss_rows
+    else:
+        del tab["sub_id"]
     return tab
 
 
@@ -770,6 +898,8 @@ def compile_scene(api) -> CompiledScene:
             ro.camera_params.find_one_float("shutteropen", 0.0),
             ro.camera_params.find_one_float("shutterclose", 1.0),
         ),
+        film_diag=film.diagonal,
+        scene_dir=getattr(api, "scene_dir", "."),
     )
     spp = ro.sampler_params.find_one_int("pixelsamples", 16)
     if getattr(opts, "quick_render", False):
@@ -1298,6 +1428,38 @@ def compile_scene(api) -> CompiledScene:
 
     from tpu_pbrt.accel.wide import build_wide, pad_tri_verts
 
+    sss_rows = mtab.pop("_sss_rows", None)
+    dev_bssrdf = None
+    if sss_rows:
+        # bake each subsurface material's per-channel beam-diffusion
+        # profile (core/bssrdf.py module doc: albedo is constant per
+        # material, so the (rho, r) spline table of bssrdf.cpp
+        # collapses to one radial profile per (material, channel))
+        from tpu_pbrt.core.bssrdf import N_RADII, BakedBSSRDF, bake_profile
+
+        M = len(sss_rows)
+        b_radii = np.zeros((M, 3, N_RADII), np.float32)
+        b_prof = np.zeros((M, 3, N_RADII), np.float32)
+        b_cdf = np.zeros((M, 3, N_RADII), np.float32)
+        b_rho = np.zeros((M, 3), np.float32)
+        b_rmax = np.zeros((M, 3), np.float32)
+        b_eta = np.zeros((M,), np.float32)
+        for mrow, (sigma_s, sigma_a, g_v, eta_v) in enumerate(sss_rows):
+            b_eta[mrow] = eta_v
+            for c in range(3):
+                ra, pr, cd, re, rm = bake_profile(
+                    float(np.asarray(sigma_s).reshape(-1)[c]),
+                    float(np.asarray(sigma_a).reshape(-1)[c]),
+                    g_v, eta_v,
+                )
+                b_radii[mrow, c], b_prof[mrow, c], b_cdf[mrow, c] = ra, pr, cd
+                b_rho[mrow, c], b_rmax[mrow, c] = re, rm
+        dev_bssrdf = BakedBSSRDF(
+            radii=jnp.asarray(b_radii), profile=jnp.asarray(b_prof),
+            cdf=jnp.asarray(b_cdf), rho_eff=jnp.asarray(b_rho),
+            r_max=jnp.asarray(b_rmax), eta=jnp.asarray(b_eta),
+        )
+
     dev = {
         "tri_verts": jnp.asarray(pad_tri_verts(verts), jnp.float32),
         **({"tri_verts1": jnp.asarray(pad_tri_verts(verts1), jnp.float32)}
@@ -1317,6 +1479,7 @@ def compile_scene(api) -> CompiledScene:
         "world_center": jnp.asarray(wcenter, jnp.float32),
         "world_radius": jnp.float32(wradius),
         "n_lights": jnp.int32(n_lights if light_rows else 0),
+        **({"bssrdf": dev_bssrdf} if dev_bssrdf is not None else {}),
     }
     # Consolidated (T, 16) per-triangle shading row [n0 n1 n2 (9) |
     # uv0 uv1 uv2 (6) | mat*4096 + light+1 as exact f32]: one
@@ -1380,6 +1543,24 @@ def compile_scene(api) -> CompiledScene:
         lv = np.asarray(verts, np.float32)[np.clip(lt_tri, 0, len(verts) - 1)]
         lv[lt_tri < 0] = 0.0
         dev["light"]["tri_v"] = jnp.asarray(lv)
+        if verts1 is not None:
+            # NEE/MIS light tables are built from the shutter-START
+            # keyframe only; intersections lerp by ray time, so an
+            # ANIMATED emissive shape gets statically-positioned light
+            # sampling (pbrt samples lights at ref.time). Loud until the
+            # light vertex table is time-lerped like Hit.tv.
+            lv1 = np.asarray(verts1, np.float32)[
+                np.clip(lt_tri, 0, len(verts) - 1)
+            ]
+            moving = (lt_tri >= 0) & (
+                np.abs(lv1 - lv).max(axis=(1, 2)) > 1e-7
+            )
+            if np.any(moving):
+                Warning(
+                    f"{int(moving.sum())} area light(s) sit on ANIMATED "
+                    "shapes: direct-light sampling uses the shutter-start "
+                    "keyframe (approximation; MIS pdfs likewise)"
+                )
     if tex_atlas is not None:
         dev["tex_atlas"] = jnp.asarray(tex_atlas, jnp.float32)
     if light_atlas_chunks:
