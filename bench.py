@@ -168,6 +168,13 @@ def main():
         "seconds": round(result.seconds, 2),
         "image_mean": round(img_mean, 6),
     }
+    # persistent-wavefront occupancy (ISSUE 1): live lanes per trace wave
+    # under compaction+regeneration — the trajectory metric next to Mray/s
+    occ = result.stats.get("mean_wave_occupancy")
+    if occ is not None:
+        _last_line["mean_wave_occupancy"] = round(float(occ), 4)
+        _last_line["trace_waves"] = int(result.stats.get("n_waves", 0))
+        _last_line["pool"] = int(result.stats.get("pool", 0))
     if not (img_mean > 1e-6):
         _last_line["error"] = "image is black — tracer broken"
 

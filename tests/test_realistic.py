@@ -12,11 +12,27 @@ from tpu_pbrt.cameras.realistic import (
     _focus,
     _stack_from_rows,
     _trace_np,
+    apply_aperture_diameter,
     builtin_doublet,
     compile_lens,
     sample_pupil,
     trace_lenses,
 )
+
+
+def test_aperturediameter_rescales_stop_row():
+    """realistic.cpp: "aperturediameter" overwrites the aperture-stop
+    element's diameter when it stops the lens down, and is clamped (with
+    the prescription winning) when it exceeds the stop's physical bound.
+    Glass-surface rows are never touched."""
+    rows = builtin_doublet(focal=0.050, ap_diam=0.010)  # stop row diam 0.010
+    out = apply_aperture_diameter(rows, 0.004)
+    stop = rows[:, 0] == 0.0
+    assert (out[stop, 3] == 0.004).all(), out[stop, 3]
+    assert (out[~stop, 3] == rows[~stop, 3]).all()
+    # larger than the stop: prescription wins
+    out2 = apply_aperture_diameter(rows, 0.05)
+    assert (out2[:, 3] == rows[:, 3]).all()
 
 
 def test_autofocus_matches_thin_lens_equation():

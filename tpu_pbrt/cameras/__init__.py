@@ -82,6 +82,7 @@ def make_camera(name: str, params, cam_to_world: xf.Transform, full_res,
             import math as _math
 
             from tpu_pbrt.cameras.realistic import (
+                apply_aperture_diameter,
                 builtin_doublet,
                 compile_lens,
                 parse_lens_file,
@@ -97,6 +98,10 @@ def make_camera(name: str, params, cam_to_world: xf.Transform, full_res,
                     rows = parse_lens_file(
                         resolve_filename(lens_file, scene_dir)
                     )
+                    # realistic.cpp: "aperturediameter" rescales the
+                    # prescription's aperture-stop element (clamped to
+                    # the stop's physical bound)
+                    rows = apply_aperture_diameter(rows, ap_diam)
                 except Exception as e:  # noqa: BLE001
                     Warning(
                         f'realistic: could not read lensfile "{lens_file}" '

@@ -97,6 +97,25 @@ def builtin_doublet(focal: float = 0.050, ap_diam: float = 0.025) -> np.ndarray:
     )
 
 
+def apply_aperture_diameter(rows: np.ndarray, ap_diam: float) -> np.ndarray:
+    """realistic.cpp constructor: the aperture-stop rows (curvature 0)
+    take the requested "aperturediameter" unless it exceeds the stop's
+    physical bound, in which case the prescription's diameter stands
+    (with a warning, as pbrt does). rows are meters (parse_lens_file
+    output); ap_diam is meters."""
+    rows = np.array(rows, np.float64, copy=True)
+    stop = rows[:, 0] == 0.0
+    too_big = stop & (rows[:, 3] < ap_diam)
+    if too_big.any():
+        Warning(
+            f"aperture diameter {ap_diam * 1000.0:.3f} mm is greater than "
+            f"the lens stop's maximum {rows[too_big, 3].max() * 1000.0:.3f} "
+            "mm; clamping to the stop"
+        )
+    rows[:, 3] = np.where(stop & ~too_big, ap_diam, rows[:, 3])
+    return rows
+
+
 def _stack_from_rows(rows: np.ndarray):
     """pbrt front-to-rear rows -> rear-to-front numpy arrays with
     absolute z apex positions (film at z=0; rear vertex z set later by
@@ -166,9 +185,10 @@ def _trace_np(stack, film_dist, o, d):
         disc = b * b - cc
         valid = disc >= 0
         sq = np.sqrt(np.maximum(disc, 0.0))
-        # realistic.cpp root choice: use the far root when (d.z > 0) ^ (R < 0)
-        use_far = (d[:, 2] > 0) ^ (R < 0)
-        t = np.where(use_far, -b + sq, -b - sq)
+        # realistic.cpp IntersectSphericalElement root choice: use the
+        # CLOSER root when (d.z > 0) ^ (R < 0), the farther one otherwise
+        use_closer = (d[:, 2] > 0) ^ (R < 0)
+        t = np.where(use_closer, -b - sq, -b + sq)
         valid &= t > 1e-9
         p = o + t[:, None] * d
         valid &= p[:, 0] ** 2 + p[:, 1] ** 2 <= ap2
@@ -314,8 +334,9 @@ def trace_lenses(lens: CompiledLens, o, d):
         cc = jnp.sum(oc * oc, axis=-1) - R * R
         disc = b * b - cc
         sq = jnp.sqrt(jnp.maximum(disc, 0.0))
-        use_far = (d[..., 2] > 0.0) ^ (R < 0.0)
-        t_sph = jnp.where(use_far, -b + sq, -b - sq)
+        # realistic.cpp root choice: CLOSER root when (d.z > 0) ^ (R < 0)
+        use_closer = (d[..., 2] > 0.0) ^ (R < 0.0)
+        t_sph = jnp.where(use_closer, -b - sq, -b + sq)
         t = jnp.where(planar, t_plane, t_sph)
         valid = (t > 1e-9) & jnp.where(planar, True, disc >= 0.0)
         p = o + t[..., None] * d

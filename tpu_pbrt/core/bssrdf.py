@@ -133,7 +133,16 @@ def beam_diffusion_ms(sigma_s: float, sigma_a: float, g: float, eta: float,
             - zv * (1.0 + sigma_tr * dv) * np.exp(-sigma_tr * dv)
             / np.maximum(dv, 1e-9) ** 3
         ) / (4.0 * math.pi)
-        out += (c_phi * phi_d + c_e * e_dn) * (rhop / _N_DEPTH)
+        # pbrt's source weighting: rhop^2 (one albedo factor for the
+        # scattering event creating the source, one for the exitance
+        # response) times the kappa correction of Habel et al. eq. 18
+        # (suppresses the dipole's overestimate at source depths the
+        # beam has not yet reached). Without both, the effective albedo
+        # saturates near 0.5 instead of approaching 1 as rho' -> 1.
+        kappa = 1.0 - np.exp(-2.0 * sigmap_t * (dr + zr))
+        out += (c_phi * phi_d + c_e * e_dn) * kappa * (
+            rhop * rhop / _N_DEPTH
+        )
     return np.maximum(out, 0.0)
 
 
@@ -215,15 +224,22 @@ def bake_profile(sigma_s: float, sigma_a: float, g: float, eta: float):
     return radii, prof, cdf_n, rho_eff, r_max
 
 
-def effective_albedo_curve(g: float, eta: float, n: int = 24):
+def effective_albedo_curve(g: float, eta: float, n: int = 48):
     """(rho_single[], rho_eff[]) for SubsurfaceFromDiffuse inversion:
-    rho_eff is monotone in the single-scattering albedo."""
-    rho_s = np.linspace(1e-3, 0.999, n)
+    rho_eff is monotone in the single-scattering albedo. The rho grid
+    uses pbrt's exponential spacing (bssrdf.cpp
+    ComputeBeamDiffusionBSSRDF): coarse near 0 where the curve is flat,
+    dense near 1 where it rises steeply toward rho_eff ~ 1 — a uniform
+    grid there makes the linear inversion land ~0.1 off for bright
+    diffuse colors."""
+    i = np.arange(n, dtype=np.float64)
+    rho_s = (1.0 - np.exp(-8.0 * i / (n - 1))) / (1.0 - math.exp(-8.0))
+    rho_s = np.clip(rho_s, 1e-4, 0.9999)
     rho_e = np.empty(n)
-    for i, rs in enumerate(rho_s):
+    for k, rs in enumerate(rho_s):
         # unit sigma_t: profiles scale with mfp, albedo does not
         _, _, _, re, _ = bake_profile(rs, 1.0 - rs, g, eta)
-        rho_e[i] = re
+        rho_e[k] = re
     return rho_s, np.maximum.accumulate(rho_e)
 
 
@@ -302,10 +318,15 @@ def pdf_sr(tab: BakedBSSRDF, mid, ch, r):
 
 def sw_eval(eta, cos_w):
     """Directional term Sw (bssrdf.h SeparableBSSRDF::Sw): the
-    normalized Fresnel transmittance of the exit crossing."""
+    normalized Fresnel transmittance of the exit crossing, with pbrt's
+    c = 1 - 2*FresnelMoment1(1/eta) normalization — by the moment
+    identity this makes the hemispherical integral of Sw*cos exactly 1
+    (pinned by tests/test_bssrdf.py::test_sw_normalization). The eta^2
+    radiance-mode factor of pbrt's SeparableBSSRDFAdapter::f is NOT
+    part of Sw; the integrator applies it once at the exit vertex."""
     from tpu_pbrt.core.bxdf import fresnel_dielectric
 
-    c = 1.0 - 2.0 * fresnel_moment1_jnp(eta)
+    c = 1.0 - 2.0 * fresnel_moment1_jnp(1.0 / eta)
     fr = fresnel_dielectric(
         jnp.abs(cos_w), jnp.ones_like(jnp.asarray(eta)), eta
     )

@@ -153,12 +153,8 @@ class Film:
         chunk when the fast path applies (the default box(0.5) filter —
         a one-pixel deposit — full-frame crop, whole-pixel chunks tiling
         the frame exactly), else 0."""
-        f = self.filter
-        box_half = f.name == "box" and f.xwidth == 0.5 and f.ywidth == 0.5
         rx, ry = self.full_resolution
-        cx0, cx1, cy0, cy1 = self.cropped_pixel_bounds
-        full = (cx0, cx1, cy0, cy1) == (0, rx, 0, ry)
-        if not box_half or not full or spp <= 0 or chunk % spp:
+        if not self.pixel_deposit_ok() or spp <= 0 or chunk % spp:
             return 0
         npc = chunk // spp
         return npc if (rx * ry) % npc == 0 else 0
@@ -214,6 +210,53 @@ class Film:
         return FilmState(
             rgb_flat.reshape(ry, rx, 3), w_flat.reshape(ry, rx), state.splat
         )
+
+    def pixel_deposit_ok(self) -> bool:
+        """Static gate for add_samples_pixel: box(0.5) filter (one-pixel
+        deposit) over the full frame."""
+        f = self.filter
+        rx, ry = self.full_resolution
+        return (
+            f.name == "box" and f.xwidth == 0.5 and f.ywidth == 0.5
+            and self.cropped_pixel_bounds == (0, rx, 0, ry)
+        )
+
+    def add_samples_pixel(
+        self, state: FilmState, px, py, L, mask, ray_weight=None
+    ) -> FilmState:
+        """add_samples for the box(0.5)/full-frame case with KNOWN integer
+        pixel coordinates: each masked sample deposits into its own pixel
+        with filter weight 1 — two masked scatter-adds instead of the
+        general path's filter footprint. Used by the persistent-wavefront
+        pool, whose terminated lanes deposit mid-loop and already carry
+        (px, py). Shares add_samples_aligned's documented deviation: a
+        jitter of exactly 0.0 deposits into the sample's own pixel only,
+        where the general footprint path would also hit the boundary
+        neighbor (the fixed-batch single-device render takes the aligned
+        path, so pool and fixed-batch images stay identical).
+        Caller must have checked pixel_deposit_ok()."""
+        L = jnp.asarray(L, jnp.float32)
+        bad = jnp.any(jnp.isnan(L) | jnp.isinf(L), axis=-1)
+        L = jnp.where(bad[..., None], 0.0, L)
+        if np.isfinite(self.max_sample_luminance):
+            y = luminance(L)
+            s = jnp.where(
+                y > self.max_sample_luminance,
+                self.max_sample_luminance / jnp.maximum(y, 1e-20), 1.0,
+            )
+            L = L * s[..., None]
+        if ray_weight is not None:
+            L = L * jnp.asarray(ray_weight, jnp.float32)[..., None]
+        rx, ryres = self.full_resolution
+        pxc = jnp.clip(px, 0, rx - 1)
+        pyc = jnp.clip(py, 0, ryres - 1)
+        rgb = state.rgb.at[pyc, pxc].add(
+            jnp.where(mask[..., None], L, 0.0)
+        )
+        wsum = state.weight.at[pyc, pxc].add(
+            jnp.where(mask, 1.0, 0.0)
+        )
+        return FilmState(rgb, wsum, state.splat)
 
     def add_splats(self, state: FilmState, p_film, v) -> FilmState:
         """Film::AddSplat over a batch (no filtering; box deposit)."""

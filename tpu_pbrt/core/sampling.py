@@ -458,15 +458,22 @@ def sobol_interval_to_index(m: int, frame, px, py):
 
 
 def _sobol_raw_bits(index, dim):
-    """32-bit Sobol value of `index` (i32, global) in dimension `dim`
-    (traced scalar or int), before scrambling."""
-    row = jax.lax.dynamic_slice(
-        _sobol_dev(), (jnp.asarray(dim, jnp.int32) % N_SOBOL_DIMS, 0),
-        (1, _SOBOL_BITS),
-    )[0]
+    """32-bit Sobol value of `index` (i32, global) in dimension `dim`,
+    before scrambling. `dim` may be a static int, a traced scalar, or a
+    PER-LANE array (the persistent-wavefront pool mixes path depths in
+    one wave, so each lane salts its own dimension)."""
+    dim = jnp.asarray(dim, jnp.int32) % N_SOBOL_DIMS
+    if dim.ndim == 0:
+        row = jax.lax.dynamic_slice(
+            _sobol_dev(), (dim, 0), (1, _SOBOL_BITS)
+        )[0]
+        cols = [row[k] for k in range(_SOBOL_BITS)]
+    else:
+        rows = jnp.take(_sobol_dev(), dim, axis=0)  # (..., 32)
+        cols = [rows[..., k] for k in range(_SOBOL_BITS)]
     out = jnp.zeros_like(index)
     for k in range(_SOBOL_BITS):
-        out = out ^ jnp.where((index >> k) & 1 != 0, row[k], 0)
+        out = out ^ jnp.where((index >> k) & 1 != 0, cols[k], 0)
     return out
 
 

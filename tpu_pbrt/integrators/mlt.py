@@ -298,7 +298,11 @@ class MLTIntegrator(WavefrontIntegrator):
             # over ICI at the end of every outer block
             from jax.sharding import NamedSharding, PartitionSpec as PS
 
-            from tpu_pbrt.parallel.mesh import TILE_AXIS, shard_map
+            from tpu_pbrt.parallel.mesh import (
+                SHARD_MAP_NOCHECK,
+                TILE_AXIS,
+                shard_map,
+            )
 
             n_dev = int(mesh.devices.size)
             pad_c = (-C) % n_dev
@@ -330,7 +334,7 @@ class MLTIntegrator(WavefrontIntegrator):
                     PS(),
                     PS(),
                 ),
-                check_vma=False,
+                **SHARD_MAP_NOCHECK,
             )
 
             def make_steps_shard(n_inner_static):

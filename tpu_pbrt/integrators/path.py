@@ -16,6 +16,23 @@ style): instead of EstimateDirect's extra BSDF-MIS shadow ray per bounce,
 the continuation ray itself carries the BSDF pdf, and emitters hit by it
 are weighted by power_heuristic(bsdf_pdf, light_pdf). Identical
 expectation to the reference estimator, one ray cheaper per bounce.
+
+Persistent wavefront (ISSUE 1 tentpole): the fixed-batch loop above leaves
+most lanes dead after the first bounces (miss / RR) while every remaining
+wave still pays full-width shading, NEE and sampling for them. The default
+render path is therefore the Laine/Karras/Aila-style wavefront with
+COMPACTION + REGENERATION (`pool_chunk`): a resident pool of path slots is
+advanced one bounce per wave; terminated lanes scatter their L into the
+film, are compacted to the pool tail with ONE packed-int32 single-key sort
+(the stream tracer's fast sort path — no float keys), and are refilled
+with fresh camera rays drained from a per-chunk work counter, so every
+trace and shading wave runs near 100% occupancy. Because every sampler
+dimension is a pure function of (px, py, s, dimension), a regenerated lane
+reproduces exactly the sample stream the fixed-batch loop would have drawn
+— the estimator (and the image, up to float accumulation order) is
+identical. `TPU_PBRT_REGEN=0` falls back to the fixed-batch loop, which
+also remains the path for scenes the pool does not support (null-interface
+materials, multi-segment Tr, the halton sampler's scalar-salt dispatch).
 """
 
 from __future__ import annotations
@@ -27,6 +44,7 @@ import jax.numpy as jnp
 
 from tpu_pbrt.core import bxdf
 from tpu_pbrt.core import lights_dev as ld
+from tpu_pbrt.core.film import FilmState
 from tpu_pbrt.core.sampling import power_heuristic, uniform_float
 from tpu_pbrt.core.vecmath import dot, normalize, offset_ray_origin, to_local, to_world
 from tpu_pbrt.integrators.common import (
@@ -40,6 +58,7 @@ from tpu_pbrt.integrators.common import (
     DIM_LIGHT_UV,
     DIM_MIX,
     DIM_RR,
+    DIM_TIME,
     DIMS_PER_BOUNCE,
     WavefrontIntegrator,
     make_interaction,
@@ -48,6 +67,54 @@ from tpu_pbrt.integrators.common import (
 from tpu_pbrt.scene.compiler import MAT_NONE
 
 PASSTHROUGH_MARGIN = 4
+
+#: compaction packs (free_flag << 30) | lane into one int32 sort key
+_POOL_LANE_BITS = 30
+
+
+class LaneSt(NamedTuple):
+    """Per-lane path state — everything a path carries between bounces.
+    Shared by the fixed-batch loop (all lanes in lockstep) and the
+    persistent pool (lanes at mixed depths)."""
+
+    o: jnp.ndarray
+    d: jnp.ndarray
+    L: jnp.ndarray
+    beta: jnp.ndarray
+    alive: jnp.ndarray
+    depth: jnp.ndarray  # per-lane real (non-null) bounces taken; also the
+    # lane's sampler-dimension salt base in pool mode
+    prev_pdf: jnp.ndarray
+    specular: jnp.ndarray
+    eta_scale: jnp.ndarray
+    prev_p: jnp.ndarray
+    sh_o: jnp.ndarray  # pending shadow ray (fused mode)
+    sh_d: jnp.ndarray
+    sh_dist: jnp.ndarray  # < 0: no pending shadow
+    ld_pend: jnp.ndarray  # beta-weighted NEE contribution awaiting
+    # the pending shadow's visibility
+
+
+def fresh_lanes(o, d) -> LaneSt:
+    """Camera-ray lane state: the MIS state treats the camera 'bounce' as
+    specular."""
+    shape = o.shape[:-1]
+    return LaneSt(
+        o=o,
+        d=d,
+        L=jnp.zeros(shape + (3,), jnp.float32),
+        beta=jnp.ones(shape + (3,), jnp.float32),
+        alive=jnp.ones(shape, bool),
+        depth=jnp.zeros(shape, jnp.int32),
+        prev_pdf=jnp.zeros(shape, jnp.float32),
+        specular=jnp.ones(shape, bool),
+        eta_scale=jnp.ones(shape, jnp.float32),
+        prev_p=o,
+        sh_o=o,
+        sh_d=d,
+        sh_dist=jnp.full(shape, -1.0, jnp.float32),
+        ld_pend=jnp.zeros(shape + (3,), jnp.float32),
+    )
 
 
 class PathIntegrator(WavefrontIntegrator):
@@ -63,14 +130,425 @@ class PathIntegrator(WavefrontIntegrator):
         # counter; scenes without null materials pay nothing (ADVICE r1).
         self.margin = PASSTHROUGH_MARGIN if scene.has_null_materials else 0
 
+    # -- regeneration support gate ----------------------------------------
+    def _regen_enabled(self) -> bool:
+        """Compaction+regeneration is ON by default for the path
+        integrator wherever the pool's preconditions hold: the fused 2R
+        wave layout (single-segment visibility, no null passthrough) and
+        a sampler whose dimension salts work per-lane (halton's pair
+        dispatch is a lax.switch on the salt and needs it scalar)."""
+        import os
+
+        if os.environ.get("TPU_PBRT_REGEN", "1") == "0":
+            return False
+        if self.vis_segments != 1 or self.margin != 0:
+            return False
+        if self.skind == "halton":
+            return False
+        return True
+
+    # -- one wavefront step ------------------------------------------------
+    def _bounce_wave(
+        self, dev, px, py, s, salt, ray_time, st: LaneSt, nrays,
+        *, fused: bool, scalar_bounce=None,
+    ):
+        """Advance every lane one bounce: trace (fused continuation +
+        pending-shadow 2R wave when `fused`), settle the previous bounce's
+        NEE, add emission with forward MIS, sample NEE + the BSDF
+        continuation, run the BSSRDF probe wave if compiled in, and apply
+        Russian roulette.
+
+        `salt` is the sampler-dimension base — the scalar loop iteration *
+        DIMS_PER_BOUNCE in fixed-batch mode, the per-lane depth *
+        DIMS_PER_BOUNCE in pool mode (identical values for any live lane,
+        so both modes draw the same streams). `scalar_bounce` enables the
+        lax.cond skip of the camera-footprint block when the whole wave
+        shares one bounce index; pool mode (None) masks per-lane instead.
+        Returns (LaneSt, nrays + this wave's per-lane traced-ray counts).
+        """
+        shape = st.o.shape[:-1]
+        o, d, L, beta, alive = st.o, st.d, st.L, st.beta, st.alive
+        depth, prev_pdf, specular = st.depth, st.prev_pdf, st.specular
+        eta_scale, prev_p = st.eta_scale, st.prev_p
+
+        # dead lanes traverse with t_max < 0: the root slab test fails
+        # immediately, so they cost one loop iteration, not a walk
+        t_max = jnp.where(alive, jnp.inf, -1.0)
+        if fused:
+            R = o.shape[0]
+            hit, sh_prim = scene_intersect_fused(
+                dev,
+                jnp.concatenate([o, st.sh_o]),
+                jnp.concatenate([d, st.sh_d]),
+                jnp.concatenate([t_max, st.sh_dist]),
+                n_cam=R,
+                # shadow rays inherit their camera sample's time
+                time=None if ray_time is None
+                else jnp.concatenate([ray_time, ray_time]),
+            )
+            # settle the previous bounce's NEE with its visibility
+            vis_prev = (st.sh_dist > 0.0) & (sh_prim < 0)
+            L = L + jnp.where(vis_prev[..., None], st.ld_pend, 0.0)
+            nrays = nrays + (st.sh_dist > 0.0).astype(jnp.int32)
+        else:
+            hit = scene_intersect(dev, o, d, t_max, time=ray_time)
+        nrays = nrays + alive.astype(jnp.int32)
+        it = make_interaction(dev, hit, o, d)
+        it.valid = it.valid & alive
+        miss = alive & (hit.prim < 0)
+
+        # camera-hit ray-differential footprint -> trilinear mip
+        # selection (camera.cpp GenerateRayDifferential +
+        # interaction.cpp ComputeDifferentials); bounce>0 vertices
+        # shade at the finest level, as pbrt does for non-specular
+        # continuations
+        import os as _os
+
+        if (self.tex_eval is not None and "tri_difT" in dev
+                and _os.environ.get("TPU_PBRT_MIPFILTER", "1") != "0"):
+            from tpu_pbrt.cameras import ray_differentials
+
+            def cam_footprint(args):
+                o_, d_, prim_, p_, ng_, valid_ = args
+                pf_c = jnp.stack(
+                    [px.astype(jnp.float32) + 0.5,
+                     py.astype(jnp.float32) + 0.5], axis=-1)
+                dox, ddx, doy, ddy = ray_differentials(
+                    self.scene.camera, pf_c)
+                w0 = texture_footprint(
+                    dev, prim_, p_, ng_, o_, d_, dox, ddx, doy, ddy
+                )
+                return jnp.where(valid_[..., None], w0, 0.0)
+
+            args = (o, d, hit.prim, it.p, it.ng, it.valid)
+            if scalar_bounce is not None:
+                # bounce > 0 shades at the finest level (pbrt's behavior
+                # for non-specular continuations) — skip the gather +
+                # plane solves entirely on those iterations
+                width = jax.lax.cond(
+                    scalar_bounce == 0,
+                    cam_footprint,
+                    lambda a: jnp.zeros(
+                        a[3].shape[:-1] + (4,), jnp.float32
+                    ),
+                    args,
+                )
+            else:
+                # pool mode: lanes at mixed depths share the wave, so the
+                # footprint is computed each wave and masked to the
+                # camera-hit (depth 0) lanes
+                width = jnp.where(
+                    (depth == 0)[..., None], cam_footprint(args), 0.0
+                )
+        else:
+            width = None
+
+        # ---- emitted radiance with forward MIS ----------------------
+        if "envmap" in dev:
+            le_env = ld.env_lookup(dev, d)
+            pdf_env = ld.infinite_pdf(dev, self.light_distr, d, ref_p=prev_p)
+            w_env = jnp.where(
+                specular, 1.0, power_heuristic(1.0, prev_pdf, 1.0, pdf_env)
+            )
+            L = L + jnp.where(miss[..., None], beta * le_env * w_env[..., None], 0.0)
+        hit_light = jnp.where(it.valid, it.light, -1)
+        le = ld.emitted_radiance(dev, hit_light, it.wo, it.ng)
+        pdf_light = ld.emitted_pdf(dev, self.light_distr, prev_p, it.p, hit_light, it.ng)
+        w_emit = jnp.where(specular, 1.0, power_heuristic(1.0, prev_pdf, 1.0, pdf_light))
+        L = L + beta * le * w_emit[..., None]
+
+        alive = alive & (hit.prim >= 0)
+        # pbrt: the vertex at bounces == maxDepth emits but neither
+        # samples lights nor continues
+        can_scatter = depth < self.max_depth
+
+        # ---- NEE: light-sampling half --------------------------------
+        mp = self.mat_at(
+            dev, it, width,
+            u_mix=self.u1d(px, py, s, salt + DIM_MIX),
+        )
+        is_null = it.valid & (mp.mtype == MAT_NONE) if self.margin else None
+        u_pick = self.u1d(px, py, s, salt + DIM_LIGHT_PICK)
+        u1, u2 = self.u2d(px, py, s, salt + DIM_LIGHT_UV)
+        ls = ld.sample_one_light(dev, self.light_distr, it.p, u_pick, u1, u2)
+        wo_l = to_local(it.wo, it.ss, it.ts, it.ns)
+        wi_l = to_local(ls.wi, it.ss, it.ts, it.ns)
+        f, bsdf_pdf = bxdf.bsdf_eval(mp, wo_l, wi_l)
+        f = f * jnp.abs(dot(ls.wi, it.ns))[..., None]
+        do_nee = (
+            it.valid
+            & can_scatter
+            & (ls.pdf > 0.0)
+            & (jnp.max(f, axis=-1) > 0.0)
+            & (jnp.max(ls.li, axis=-1) > 0.0)
+        )
+        o_sh = offset_ray_origin(it.p, it.ng, ls.wi)
+        sh_dist = jnp.where(do_nee, ls.dist, -1.0)  # fast-exit dead lanes
+        w_l = jnp.where(ls.is_delta, 1.0, power_heuristic(1.0, ls.pdf, 1.0, bsdf_pdf))
+        Ld = f * ls.li * (w_l / jnp.maximum(ls.pdf, 1e-20))[..., None]
+        if fused:
+            # queue the shadow ray; it rides the NEXT iteration's fused
+            # wave (the 0.999 dist margin matches unoccluded_tr)
+            sh_o_n = o_sh
+            sh_d_n = ls.wi
+            sh_dist_n = jnp.where(do_nee, sh_dist * 0.999, -1.0)
+            ld_pend_n = jnp.where(do_nee[..., None], beta * Ld, 0.0)
+        else:
+            visible, _ = unoccluded_tr(
+                dev, o_sh, ls.wi, sh_dist, None, px, py, s,
+                salt + DIM_LIGHT_UV + 200, segments=self.vis_segments,
+            )
+            nrays = nrays + do_nee.astype(jnp.int32)
+            L = L + jnp.where((do_nee & visible)[..., None], beta * Ld, 0.0)
+
+        # ---- continuation: BSDF sample -------------------------------
+        ul = self.u1d(px, py, s, salt + DIM_BSDF_LOBE)
+        ub1, ub2 = self.u2d(px, py, s, salt + DIM_BSDF_UV)
+        bs = bxdf.bsdf_sample(mp, wo_l, ul, ub1, ub2)
+        wi_w = normalize(to_world(bs.wi, it.ss, it.ts, it.ns))
+        cont = it.valid & can_scatter & (bs.pdf > 0.0) & (jnp.max(bs.f, axis=-1) > 0.0)
+        throughput = bs.f * (jnp.abs(dot(wi_w, it.ns)) / jnp.maximum(bs.pdf, 1e-20))[..., None]
+        beta = jnp.where(cont[..., None], beta * throughput, beta)
+        # eta^2 tracking for RR (path.cpp etaScale)
+        eta2 = (mp.eta[..., 0]) ** 2
+        going_in = dot(it.wo, it.ns) > 0.0
+        scale = jnp.where(going_in, eta2, 1.0 / jnp.maximum(eta2, 1e-12))
+        eta_scale = jnp.where(cont & bs.is_transmission, eta_scale * scale, eta_scale)
+
+        prev_p = jnp.where(cont[..., None], it.p, prev_p)
+        o = jnp.where(cont[..., None], offset_ray_origin(it.p, it.ng, wi_w), o)
+        d = jnp.where(cont[..., None], wi_w, d)
+        prev_pdf = jnp.where(cont, bs.pdf, prev_pdf)
+        specular = jnp.where(cont, bs.is_specular, specular)
+        depth = depth + cont.astype(jnp.int32)
+        alive = cont
+
+        # ---- BSSRDF probe wave (bssrdf.cpp Sample_S/Sample_Sp,
+        # path.cpp's bssrdf block; compiled ONLY for scenes with
+        # subsurface materials). A lane whose interface sample was
+        # the specular TRANSMISSION re-emerges at an exit vertex
+        # found by a fixed-K probe chord: axis/channel MIS picks a
+        # radius from the baked diffusion CDF, the chord is
+        # intersected K times collecting same-material hits with
+        # reservoir selection, and the lane continues from the exit
+        # with the Sw directional lobe (NEE + cosine continuation
+        # inline below — the wavefront analog of pbrt's Sw-adapter
+        # BSDF at pi). Entry Fresnel rides the interface sample;
+        # f*cos/pdf of the specular transmission is 1, so beta here
+        # gains exactly Sp * nFound / Pdf_Sp then Sw*pi. -----------
+        if "bssrdf" in dev:
+            from tpu_pbrt.core.bssrdf import (
+                pdf_sr,
+                sample_sr,
+                sr_eval,
+                sw_eval,
+            )
+            from tpu_pbrt.core.sampling import cosine_sample_hemisphere
+            from tpu_pbrt.core.smalltab import small_take
+
+            tabS = dev["bssrdf"]
+            sub = jnp.maximum(mp.sub, 0)
+            sss = cont & (mp.sub >= 0) & bs.is_transmission
+            ua = self.u1d(px, py, s, salt + 12)
+            uc = self.u1d(px, py, s, salt + 13)
+            ur_ = self.u1d(px, py, s, salt + 14)
+            uphi = self.u1d(px, py, s, salt + 15)
+            # probe frame: ns axis w.p. 1/2, ss/ts each 1/4
+            ax0 = (ua < 0.5)[..., None]
+            ax1 = ((ua >= 0.5) & (ua < 0.75))[..., None]
+            vz = jnp.where(ax0, it.ns, jnp.where(ax1, it.ss, it.ts))
+            vx = jnp.where(ax0, it.ss, jnp.where(ax1, it.ts, it.ns))
+            vy = jnp.where(ax0, it.ts, jnp.where(ax1, it.ns, it.ss))
+            ch = jnp.clip((uc * 3.0).astype(jnp.int32), 0, 2)
+            r_s = sample_sr(tabS, sub, ch, ur_)
+            rmax_c = jnp.take_along_axis(
+                tabS.r_max[sub], ch[..., None], axis=-1
+            )[..., 0]
+            l_ch = 2.0 * jnp.sqrt(jnp.maximum(rmax_c**2 - r_s**2, 0.0))
+            phi_s = 2.0 * jnp.pi * uphi
+            start = (
+                it.p
+                + r_s[..., None] * (
+                    jnp.cos(phi_s)[..., None] * vx
+                    + jnp.sin(phi_s)[..., None] * vy
+                )
+                + (0.5 * l_ch)[..., None] * vz
+            )
+            pdir = -vz
+            ok_r = sss & (r_s < rmax_c) & (l_ch > 0.0)
+
+            cur_o = start
+            t_rem = jnp.where(ok_r, l_ch, -1.0)
+            n_found = jnp.zeros(shape, jnp.int32)
+            sel_p, sel_ng, sel_ns = it.p, it.ng, it.ns
+            sel_ss, sel_ts = it.ss, it.ts
+            for k in range(4):
+                hitk = scene_intersect(
+                    dev, cur_o, pdir, t_rem, time=ray_time
+                )
+                itk = make_interaction(dev, hitk, cur_o, pdir)
+                nrays = nrays + (t_rem > 0.0).astype(jnp.int32)
+                m_sub = small_take(
+                    dev["mat"]["sub_id"], jnp.maximum(itk.mat, 0)
+                )
+                matchk = itk.valid & (m_sub == sub) & ok_r
+                n_found = n_found + matchk.astype(jnp.int32)
+                u_res = uniform_float(px, py, s, salt + 4000 + k)
+                takek = matchk & (
+                    u_res * n_found.astype(jnp.float32) < 1.0
+                )
+                tk = takek[..., None]
+                sel_p = jnp.where(tk, itk.p, sel_p)
+                sel_ng = jnp.where(tk, itk.ng, sel_ng)
+                sel_ns = jnp.where(tk, itk.ns, sel_ns)
+                sel_ss = jnp.where(tk, itk.ss, sel_ss)
+                sel_ts = jnp.where(tk, itk.ts, sel_ts)
+                adv = jnp.where(itk.valid, hitk.t + 1e-4, jnp.inf)
+                cur_o = cur_o + adv[..., None] * pdir
+                t_rem = jnp.where(itk.valid, t_rem - adv, -1.0)
+
+            ok_exit = ok_r & (n_found > 0)
+            dvec = sel_p - it.p
+            dist_s = jnp.linalg.norm(dvec, axis=-1)
+            sp = sr_eval(tabS, sub, dist_s)  # (R, 3)
+            # Pdf_Sp: MIS over the 3 axes x 3 channels of projected
+            # radii (bssrdf.cpp Pdf_Sp)
+            dl = jnp.stack(
+                [dot(dvec, it.ss), dot(dvec, it.ts), dot(dvec, it.ns)],
+                axis=-1,
+            )
+            nl = jnp.stack(
+                [dot(sel_ns, it.ss), dot(sel_ns, it.ts),
+                 dot(sel_ns, it.ns)], axis=-1,
+            )
+            rproj = jnp.stack(
+                [
+                    jnp.sqrt(dl[..., 1] ** 2 + dl[..., 2] ** 2),
+                    jnp.sqrt(dl[..., 2] ** 2 + dl[..., 0] ** 2),
+                    jnp.sqrt(dl[..., 0] ** 2 + dl[..., 1] ** 2),
+                ],
+                axis=-1,
+            )
+            ax_prob = (0.25, 0.25, 0.5)
+            pdf_tot = jnp.zeros(shape, jnp.float32)
+            for a in range(3):
+                for c in range(3):
+                    pdf_tot = pdf_tot + pdf_sr(
+                        tabS, sub, jnp.full_like(ch, c), rproj[..., a]
+                    ) * jnp.abs(nl[..., a]) * (ax_prob[a] / 3.0)
+            ok_exit = ok_exit & (pdf_tot > 0.0) & (
+                jnp.max(sp, axis=-1) > 0.0
+            )
+            w_sss = sp * (
+                n_found.astype(jnp.float32)
+                / jnp.maximum(pdf_tot, 1e-20)
+            )[..., None]
+            beta = jnp.where(ok_exit[..., None], beta * w_sss, beta)
+
+            # exit-vertex NEE with the Sw lobe (pbrt's Sw adapter); the
+            # adapter's eta^2 radiance-mode factor (non-symmetric
+            # scattering at the refractive exit) is applied once to beta
+            # here so both the NEE term and the continuation carry it
+            eta_sub = tabS.eta[sub]
+            beta = jnp.where(
+                ok_exit[..., None], beta * (eta_sub * eta_sub)[..., None],
+                beta,
+            )
+            ls2 = ld.sample_one_light(
+                dev, self.light_distr, sel_p,
+                uniform_float(px, py, s, salt + 4100),
+                uniform_float(px, py, s, salt + 4101),
+                uniform_float(px, py, s, salt + 4102),
+            )
+            cos_l = dot(ls2.wi, sel_ns)
+            f_sw_l = sw_eval(eta_sub, cos_l) * jnp.maximum(cos_l, 0.0)
+            do2 = (
+                ok_exit & can_scatter & (ls2.pdf > 0.0) & (cos_l > 1e-6)
+                & (jnp.max(ls2.li, axis=-1) > 0.0)
+            )
+            occ2 = scene_intersect_p(
+                dev, offset_ray_origin(sel_p, sel_ng, ls2.wi), ls2.wi,
+                jnp.where(do2, ls2.dist * 0.999, -1.0),
+            )
+            nrays = nrays + do2.astype(jnp.int32)
+            w_l2 = jnp.where(
+                ls2.is_delta, 1.0,
+                power_heuristic(1.0, ls2.pdf, 1.0, cos_l / jnp.pi),
+            )
+            L = L + jnp.where(
+                (do2 & ~occ2)[..., None],
+                beta * f_sw_l[..., None] * ls2.li
+                * (w_l2 / jnp.maximum(ls2.pdf, 1e-20))[..., None],
+                0.0,
+            )
+
+            # cosine continuation from the exit with Sw weighting:
+            # beta *= Sw * cos / (cos/pi) = Sw * pi
+            wloc = cosine_sample_hemisphere(
+                uniform_float(px, py, s, salt + 4103),
+                uniform_float(px, py, s, salt + 4104),
+            )
+            wi2 = normalize(
+                wloc[..., 0:1] * sel_ss + wloc[..., 1:2] * sel_ts
+                + wloc[..., 2:3] * sel_ns
+            )
+            cos2 = jnp.maximum(dot(wi2, sel_ns), 1e-6)
+            beta = jnp.where(
+                ok_exit[..., None],
+                beta * (sw_eval(eta_sub, cos2) * jnp.pi)[..., None],
+                beta,
+            )
+            o = jnp.where(
+                ok_exit[..., None],
+                offset_ray_origin(sel_p, sel_ng, wi2), o,
+            )
+            d = jnp.where(ok_exit[..., None], wi2, d)
+            prev_p = jnp.where(ok_exit[..., None], sel_p, prev_p)
+            prev_pdf = jnp.where(ok_exit, cos2 / jnp.pi, prev_pdf)
+            specular = specular & ~ok_exit
+            alive = jnp.where(sss, ok_exit, alive)
+
+        # ---- null passthrough (uncounted bounce, path.cpp bounces--)
+        if is_null is not None:
+            alive = alive | is_null
+            o = jnp.where(is_null[..., None], offset_ray_origin(it.p, it.ng, d), o)
+            # d/beta/prev_pdf/specular/prev_p unchanged: the crossing is
+            # not a scattering event; MIS still references the last real
+            # vertex
+
+        # ---- Russian roulette. pbrt path.cpp tests `bounces > 3` at
+        # the END of iteration `bounces`; our per-lane `depth` counter
+        # is post-increment here (depth == bounces + 1 for a lane that
+        # continued every iteration), so `depth > 4` is the SAME
+        # schedule — first possible kill after the 5th real bounce is
+        # sampled. depth counts REAL bounces only: null crossings must
+        # not advance RR (pbrt's bounces-- semantics). ----------------
+        rr_on = depth > 4
+        rr_beta = jnp.max(beta, axis=-1) * eta_scale
+        q = jnp.maximum(0.05, 1.0 - rr_beta)
+        u_rr = uniform_float(px, py, s, salt + DIM_RR)
+        rr_cand = alive & rr_on & (rr_beta < self.rr_threshold)
+        kill = rr_cand & (u_rr < q)
+        survive_scale = jnp.where(rr_cand & ~kill, 1.0 / jnp.maximum(1.0 - q, 1e-6), 1.0)
+        beta = beta * survive_scale[..., None]
+        alive = alive & ~kill
+
+        if fused:
+            pend = (sh_o_n, sh_d_n, sh_dist_n, ld_pend_n)
+        else:
+            pend = (st.sh_o, st.sh_d, st.sh_dist, st.ld_pend)
+        return LaneSt(
+            o, d, L, beta, alive, depth, prev_pdf, specular, eta_scale,
+            prev_p, *pend,
+        ), nrays
+
+    # -- fixed-batch loop (TPU_PBRT_REGEN=0 fallback; non-fused scenes) ----
     def li(self, dev, o, d, px, py, s):
         shape = o.shape[:-1]
         # motion blur: one shutter time per camera sample, fixed along
         # the whole path (CameraSample::time); keyframes are the shutter
         # endpoints, so the normalized time IS the sample
         if "tri_verts1" in dev:
-            from tpu_pbrt.integrators.common import DIM_TIME
-
             ray_time = self.u1d(px, py, s, DIM_TIME)
         else:
             ray_time = None
@@ -85,414 +563,197 @@ class PathIntegrator(WavefrontIntegrator):
 
         class St(NamedTuple):
             bounce: jnp.ndarray  # scalar: loop iteration (= sampler salt base)
-            o: jnp.ndarray
-            d: jnp.ndarray
-            L: jnp.ndarray
-            beta: jnp.ndarray
-            alive: jnp.ndarray
             nrays: jnp.ndarray
-            depth: jnp.ndarray  # per-lane real (non-null) bounces taken
-            prev_pdf: jnp.ndarray
-            specular: jnp.ndarray
-            eta_scale: jnp.ndarray
-            prev_p: jnp.ndarray
-            sh_o: jnp.ndarray  # pending shadow ray (fused mode)
-            sh_d: jnp.ndarray
-            sh_dist: jnp.ndarray  # < 0: no pending shadow
-            ld_pend: jnp.ndarray  # beta-weighted NEE contribution awaiting
-            # the pending shadow's visibility
+            lane: LaneSt
 
         def cond(st: St):
-            live = jnp.any(st.alive)
+            live = jnp.any(st.lane.alive)
             if fused:
                 # one extra iteration may be needed to settle the last
                 # pending shadow ray
                 return (st.bounce < max_iters + 1) & (
-                    live | jnp.any(st.sh_dist > 0.0)
+                    live | jnp.any(st.lane.sh_dist > 0.0)
                 )
             return (st.bounce < max_iters) & live
 
         def body(st: St):
-            bounce = st.bounce
-            salt = bounce * DIMS_PER_BOUNCE
-            o, d, L, beta, alive = st.o, st.d, st.L, st.beta, st.alive
-            depth, prev_pdf, specular = st.depth, st.prev_pdf, st.specular
-            eta_scale, prev_p, nrays = st.eta_scale, st.prev_p, st.nrays
-
-            # dead lanes traverse with t_max < 0: the root slab test fails
-            # immediately, so they cost one loop iteration, not a walk
-            t_max = jnp.where(alive, jnp.inf, -1.0)
-            if fused:
-                R = o.shape[0]
-                hit, sh_prim = scene_intersect_fused(
-                    dev,
-                    jnp.concatenate([o, st.sh_o]),
-                    jnp.concatenate([d, st.sh_d]),
-                    jnp.concatenate([t_max, st.sh_dist]),
-                    n_cam=R,
-                    # shadow rays inherit their camera sample's time
-                    time=None if ray_time is None
-                    else jnp.concatenate([ray_time, ray_time]),
-                )
-                # settle the previous bounce's NEE with its visibility
-                vis_prev = (st.sh_dist > 0.0) & (sh_prim < 0)
-                L = L + jnp.where(vis_prev[..., None], st.ld_pend, 0.0)
-                nrays = nrays + (st.sh_dist > 0.0).astype(jnp.int32)
-            else:
-                hit = scene_intersect(dev, o, d, t_max, time=ray_time)
-            nrays = nrays + alive.astype(jnp.int32)
-            it = make_interaction(dev, hit, o, d)
-            it.valid = it.valid & alive
-            miss = alive & (hit.prim < 0)
-
-            # camera-hit ray-differential footprint -> trilinear mip
-            # selection (camera.cpp GenerateRayDifferential +
-            # interaction.cpp ComputeDifferentials); bounce>0 vertices
-            # shade at the finest level, as pbrt does for non-specular
-            # continuations
-            import os as _os
-
-            if (self.tex_eval is not None and "tri_difT" in dev
-                    and _os.environ.get("TPU_PBRT_MIPFILTER", "1") != "0"):
-                from tpu_pbrt.cameras import ray_differentials
-
-                def cam_footprint(args):
-                    o_, d_, prim_, p_, ng_, valid_ = args
-                    pf_c = jnp.stack(
-                        [px.astype(jnp.float32) + 0.5,
-                         py.astype(jnp.float32) + 0.5], axis=-1)
-                    dox, ddx, doy, ddy = ray_differentials(
-                        self.scene.camera, pf_c)
-                    w0 = texture_footprint(
-                        dev, prim_, p_, ng_, o_, d_, dox, ddx, doy, ddy
-                    )
-                    return jnp.where(valid_[..., None], w0, 0.0)
-
-                # bounce > 0 shades at the finest level (pbrt's behavior
-                # for non-specular continuations) — skip the gather +
-                # plane solves entirely on those iterations
-                width = jax.lax.cond(
-                    bounce == 0,
-                    cam_footprint,
-                    lambda args: jnp.zeros(
-                        args[3].shape[:-1] + (4,), jnp.float32
-                    ),
-                    (o, d, hit.prim, it.p, it.ng, it.valid),
-                )
-            else:
-                width = None
-
-            # ---- emitted radiance with forward MIS ----------------------
-            if "envmap" in dev:
-                le_env = ld.env_lookup(dev, d)
-                pdf_env = ld.infinite_pdf(dev, self.light_distr, d, ref_p=prev_p)
-                w_env = jnp.where(
-                    specular, 1.0, power_heuristic(1.0, prev_pdf, 1.0, pdf_env)
-                )
-                L = L + jnp.where(miss[..., None], beta * le_env * w_env[..., None], 0.0)
-            hit_light = jnp.where(it.valid, it.light, -1)
-            le = ld.emitted_radiance(dev, hit_light, it.wo, it.ng)
-            pdf_light = ld.emitted_pdf(dev, self.light_distr, prev_p, it.p, hit_light, it.ng)
-            w_emit = jnp.where(specular, 1.0, power_heuristic(1.0, prev_pdf, 1.0, pdf_light))
-            L = L + beta * le * w_emit[..., None]
-
-            alive = alive & (hit.prim >= 0)
-            # pbrt: the vertex at bounces == maxDepth emits but neither
-            # samples lights nor continues
-            can_scatter = depth < self.max_depth
-
-            # ---- NEE: light-sampling half only --------------------------
-            mp = self.mat_at(
-                dev, it, width,
-                u_mix=self.u1d(px, py, s, salt + DIM_MIX),
+            salt = st.bounce * DIMS_PER_BOUNCE
+            lane, nrays = self._bounce_wave(
+                dev, px, py, s, salt, ray_time, st.lane, st.nrays,
+                fused=fused, scalar_bounce=st.bounce,
             )
-            is_null = it.valid & (mp.mtype == MAT_NONE) if self.margin else None
-            u_pick = self.u1d(px, py, s, salt + DIM_LIGHT_PICK)
-            u1, u2 = self.u2d(px, py, s, salt + DIM_LIGHT_UV)
-            ls = ld.sample_one_light(dev, self.light_distr, it.p, u_pick, u1, u2)
-            wo_l = to_local(it.wo, it.ss, it.ts, it.ns)
-            wi_l = to_local(ls.wi, it.ss, it.ts, it.ns)
-            f, bsdf_pdf = bxdf.bsdf_eval(mp, wo_l, wi_l)
-            f = f * jnp.abs(dot(ls.wi, it.ns))[..., None]
-            do_nee = (
-                it.valid
-                & can_scatter
-                & (ls.pdf > 0.0)
-                & (jnp.max(f, axis=-1) > 0.0)
-                & (jnp.max(ls.li, axis=-1) > 0.0)
-            )
-            o_sh = offset_ray_origin(it.p, it.ng, ls.wi)
-            sh_dist = jnp.where(do_nee, ls.dist, -1.0)  # fast-exit dead lanes
-            w_l = jnp.where(ls.is_delta, 1.0, power_heuristic(1.0, ls.pdf, 1.0, bsdf_pdf))
-            Ld = f * ls.li * (w_l / jnp.maximum(ls.pdf, 1e-20))[..., None]
-            if fused:
-                # queue the shadow ray; it rides the NEXT iteration's fused
-                # wave (the 0.999 dist margin matches unoccluded_tr)
-                sh_o_n = o_sh
-                sh_d_n = ls.wi
-                sh_dist_n = jnp.where(do_nee, sh_dist * 0.999, -1.0)
-                ld_pend_n = jnp.where(do_nee[..., None], beta * Ld, 0.0)
-            else:
-                visible, _ = unoccluded_tr(
-                    dev, o_sh, ls.wi, sh_dist, None, px, py, s,
-                    salt + DIM_LIGHT_UV + 200, segments=self.vis_segments,
-                )
-                nrays = nrays + do_nee.astype(jnp.int32)
-                L = L + jnp.where((do_nee & visible)[..., None], beta * Ld, 0.0)
-
-            # ---- continuation: BSDF sample ------------------------------
-            ul = self.u1d(px, py, s, salt + DIM_BSDF_LOBE)
-            ub1, ub2 = self.u2d(px, py, s, salt + DIM_BSDF_UV)
-            bs = bxdf.bsdf_sample(mp, wo_l, ul, ub1, ub2)
-            wi_w = normalize(to_world(bs.wi, it.ss, it.ts, it.ns))
-            cont = it.valid & can_scatter & (bs.pdf > 0.0) & (jnp.max(bs.f, axis=-1) > 0.0)
-            throughput = bs.f * (jnp.abs(dot(wi_w, it.ns)) / jnp.maximum(bs.pdf, 1e-20))[..., None]
-            beta = jnp.where(cont[..., None], beta * throughput, beta)
-            # eta^2 tracking for RR (path.cpp etaScale)
-            eta2 = (mp.eta[..., 0]) ** 2
-            going_in = dot(it.wo, it.ns) > 0.0
-            scale = jnp.where(going_in, eta2, 1.0 / jnp.maximum(eta2, 1e-12))
-            eta_scale = jnp.where(cont & bs.is_transmission, eta_scale * scale, eta_scale)
-
-            prev_p = jnp.where(cont[..., None], it.p, prev_p)
-            o = jnp.where(cont[..., None], offset_ray_origin(it.p, it.ng, wi_w), o)
-            d = jnp.where(cont[..., None], wi_w, d)
-            prev_pdf = jnp.where(cont, bs.pdf, prev_pdf)
-            specular = jnp.where(cont, bs.is_specular, specular)
-            depth = depth + cont.astype(jnp.int32)
-            alive = cont
-
-            # ---- BSSRDF probe wave (bssrdf.cpp Sample_S/Sample_Sp,
-            # path.cpp's bssrdf block; compiled ONLY for scenes with
-            # subsurface materials). A lane whose interface sample was
-            # the specular TRANSMISSION re-emerges at an exit vertex
-            # found by a fixed-K probe chord: axis/channel MIS picks a
-            # radius from the baked diffusion CDF, the chord is
-            # intersected K times collecting same-material hits with
-            # reservoir selection, and the lane continues from the exit
-            # with the Sw directional lobe (NEE + cosine continuation
-            # inline below — the wavefront analog of pbrt's Sw-adapter
-            # BSDF at pi). Entry Fresnel rides the interface sample;
-            # f*cos/pdf of the specular transmission is 1, so beta here
-            # gains exactly Sp * nFound / Pdf_Sp then Sw*pi. -----------
-            if "bssrdf" in dev:
-                from tpu_pbrt.core.bssrdf import (
-                    pdf_sr,
-                    sample_sr,
-                    sr_eval,
-                    sw_eval,
-                )
-                from tpu_pbrt.core.sampling import cosine_sample_hemisphere
-                from tpu_pbrt.core.smalltab import small_take
-
-                tabS = dev["bssrdf"]
-                sub = jnp.maximum(mp.sub, 0)
-                sss = cont & (mp.sub >= 0) & bs.is_transmission
-                ua = self.u1d(px, py, s, salt + 12)
-                uc = self.u1d(px, py, s, salt + 13)
-                ur_ = self.u1d(px, py, s, salt + 14)
-                uphi = self.u1d(px, py, s, salt + 15)
-                # probe frame: ns axis w.p. 1/2, ss/ts each 1/4
-                ax0 = (ua < 0.5)[..., None]
-                ax1 = ((ua >= 0.5) & (ua < 0.75))[..., None]
-                vz = jnp.where(ax0, it.ns, jnp.where(ax1, it.ss, it.ts))
-                vx = jnp.where(ax0, it.ss, jnp.where(ax1, it.ts, it.ns))
-                vy = jnp.where(ax0, it.ts, jnp.where(ax1, it.ns, it.ss))
-                ch = jnp.clip((uc * 3.0).astype(jnp.int32), 0, 2)
-                r_s = sample_sr(tabS, sub, ch, ur_)
-                rmax_c = jnp.take_along_axis(
-                    tabS.r_max[sub], ch[..., None], axis=-1
-                )[..., 0]
-                l_ch = 2.0 * jnp.sqrt(jnp.maximum(rmax_c**2 - r_s**2, 0.0))
-                phi_s = 2.0 * jnp.pi * uphi
-                start = (
-                    it.p
-                    + r_s[..., None] * (
-                        jnp.cos(phi_s)[..., None] * vx
-                        + jnp.sin(phi_s)[..., None] * vy
-                    )
-                    + (0.5 * l_ch)[..., None] * vz
-                )
-                pdir = -vz
-                ok_r = sss & (r_s < rmax_c) & (l_ch > 0.0)
-
-                cur_o = start
-                t_rem = jnp.where(ok_r, l_ch, -1.0)
-                n_found = jnp.zeros(shape, jnp.int32)
-                sel_p, sel_ng, sel_ns = it.p, it.ng, it.ns
-                sel_ss, sel_ts = it.ss, it.ts
-                for k in range(4):
-                    hitk = scene_intersect(
-                        dev, cur_o, pdir, t_rem, time=ray_time
-                    )
-                    itk = make_interaction(dev, hitk, cur_o, pdir)
-                    nrays = nrays + (t_rem > 0.0).astype(jnp.int32)
-                    m_sub = small_take(
-                        dev["mat"]["sub_id"], jnp.maximum(itk.mat, 0)
-                    )
-                    matchk = itk.valid & (m_sub == sub) & ok_r
-                    n_found = n_found + matchk.astype(jnp.int32)
-                    u_res = uniform_float(px, py, s, salt + 4000 + k)
-                    takek = matchk & (
-                        u_res * n_found.astype(jnp.float32) < 1.0
-                    )
-                    tk = takek[..., None]
-                    sel_p = jnp.where(tk, itk.p, sel_p)
-                    sel_ng = jnp.where(tk, itk.ng, sel_ng)
-                    sel_ns = jnp.where(tk, itk.ns, sel_ns)
-                    sel_ss = jnp.where(tk, itk.ss, sel_ss)
-                    sel_ts = jnp.where(tk, itk.ts, sel_ts)
-                    adv = jnp.where(itk.valid, hitk.t + 1e-4, jnp.inf)
-                    cur_o = cur_o + adv[..., None] * pdir
-                    t_rem = jnp.where(itk.valid, t_rem - adv, -1.0)
-
-                ok_exit = ok_r & (n_found > 0)
-                dvec = sel_p - it.p
-                dist_s = jnp.linalg.norm(dvec, axis=-1)
-                sp = sr_eval(tabS, sub, dist_s)  # (R, 3)
-                # Pdf_Sp: MIS over the 3 axes x 3 channels of projected
-                # radii (bssrdf.cpp Pdf_Sp)
-                dl = jnp.stack(
-                    [dot(dvec, it.ss), dot(dvec, it.ts), dot(dvec, it.ns)],
-                    axis=-1,
-                )
-                nl = jnp.stack(
-                    [dot(sel_ns, it.ss), dot(sel_ns, it.ts),
-                     dot(sel_ns, it.ns)], axis=-1,
-                )
-                rproj = jnp.stack(
-                    [
-                        jnp.sqrt(dl[..., 1] ** 2 + dl[..., 2] ** 2),
-                        jnp.sqrt(dl[..., 2] ** 2 + dl[..., 0] ** 2),
-                        jnp.sqrt(dl[..., 0] ** 2 + dl[..., 1] ** 2),
-                    ],
-                    axis=-1,
-                )
-                ax_prob = (0.25, 0.25, 0.5)
-                pdf_tot = jnp.zeros(shape, jnp.float32)
-                for a in range(3):
-                    for c in range(3):
-                        pdf_tot = pdf_tot + pdf_sr(
-                            tabS, sub, jnp.full_like(ch, c), rproj[..., a]
-                        ) * jnp.abs(nl[..., a]) * (ax_prob[a] / 3.0)
-                ok_exit = ok_exit & (pdf_tot > 0.0) & (
-                    jnp.max(sp, axis=-1) > 0.0
-                )
-                w_sss = sp * (
-                    n_found.astype(jnp.float32)
-                    / jnp.maximum(pdf_tot, 1e-20)
-                )[..., None]
-                beta = jnp.where(ok_exit[..., None], beta * w_sss, beta)
-
-                # exit-vertex NEE with the Sw lobe (pbrt's Sw adapter)
-                eta_sub = tabS.eta[sub]
-                ls2 = ld.sample_one_light(
-                    dev, self.light_distr, sel_p,
-                    uniform_float(px, py, s, salt + 4100),
-                    uniform_float(px, py, s, salt + 4101),
-                    uniform_float(px, py, s, salt + 4102),
-                )
-                cos_l = dot(ls2.wi, sel_ns)
-                f_sw_l = sw_eval(eta_sub, cos_l) * jnp.maximum(cos_l, 0.0)
-                do2 = (
-                    ok_exit & can_scatter & (ls2.pdf > 0.0) & (cos_l > 1e-6)
-                    & (jnp.max(ls2.li, axis=-1) > 0.0)
-                )
-                occ2 = scene_intersect_p(
-                    dev, offset_ray_origin(sel_p, sel_ng, ls2.wi), ls2.wi,
-                    jnp.where(do2, ls2.dist * 0.999, -1.0),
-                )
-                nrays = nrays + do2.astype(jnp.int32)
-                w_l2 = jnp.where(
-                    ls2.is_delta, 1.0,
-                    power_heuristic(1.0, ls2.pdf, 1.0, cos_l / jnp.pi),
-                )
-                L = L + jnp.where(
-                    (do2 & ~occ2)[..., None],
-                    beta * f_sw_l[..., None] * ls2.li
-                    * (w_l2 / jnp.maximum(ls2.pdf, 1e-20))[..., None],
-                    0.0,
-                )
-
-                # cosine continuation from the exit with Sw weighting:
-                # beta *= Sw * cos / (cos/pi) = Sw * pi
-                wloc = cosine_sample_hemisphere(
-                    uniform_float(px, py, s, salt + 4103),
-                    uniform_float(px, py, s, salt + 4104),
-                )
-                wi2 = normalize(
-                    wloc[..., 0:1] * sel_ss + wloc[..., 1:2] * sel_ts
-                    + wloc[..., 2:3] * sel_ns
-                )
-                cos2 = jnp.maximum(dot(wi2, sel_ns), 1e-6)
-                beta = jnp.where(
-                    ok_exit[..., None],
-                    beta * (sw_eval(eta_sub, cos2) * jnp.pi)[..., None],
-                    beta,
-                )
-                o = jnp.where(
-                    ok_exit[..., None],
-                    offset_ray_origin(sel_p, sel_ng, wi2), o,
-                )
-                d = jnp.where(ok_exit[..., None], wi2, d)
-                prev_p = jnp.where(ok_exit[..., None], sel_p, prev_p)
-                prev_pdf = jnp.where(ok_exit, cos2 / jnp.pi, prev_pdf)
-                specular = specular & ~ok_exit
-                alive = jnp.where(sss, ok_exit, alive)
-
-            # ---- null passthrough (uncounted bounce, path.cpp bounces--)
-            if is_null is not None:
-                alive = alive | is_null
-                o = jnp.where(is_null[..., None], offset_ray_origin(it.p, it.ng, d), o)
-                # d/beta/prev_pdf/specular/prev_p unchanged: the crossing is
-                # not a scattering event; MIS still references the last real
-                # vertex
-
-            # ---- Russian roulette. pbrt path.cpp tests `bounces > 3` at
-            # the END of iteration `bounces`; our per-lane `depth` counter
-            # is post-increment here (depth == bounces + 1 for a lane that
-            # continued every iteration), so `depth > 4` is the SAME
-            # schedule — first possible kill after the 5th real bounce is
-            # sampled. depth counts REAL bounces only: null crossings must
-            # not advance RR (pbrt's bounces-- semantics). ----------------
-            rr_on = depth > 4
-            rr_beta = jnp.max(beta, axis=-1) * eta_scale
-            q = jnp.maximum(0.05, 1.0 - rr_beta)
-            u_rr = uniform_float(px, py, s, salt + DIM_RR)
-            rr_cand = alive & rr_on & (rr_beta < self.rr_threshold)
-            kill = rr_cand & (u_rr < q)
-            survive_scale = jnp.where(rr_cand & ~kill, 1.0 / jnp.maximum(1.0 - q, 1e-6), 1.0)
-            beta = beta * survive_scale[..., None]
-            alive = alive & ~kill
-
-            if fused:
-                pend = (sh_o_n, sh_d_n, sh_dist_n, ld_pend_n)
-            else:
-                pend = (st.sh_o, st.sh_d, st.sh_dist, st.ld_pend)
-            return St(
-                bounce + 1, o, d, L, beta, alive, nrays, depth,
-                prev_pdf, specular, eta_scale, prev_p, *pend,
-            )
+            return St(st.bounce + 1, nrays, lane)
 
         init = St(
             bounce=jnp.int32(0),
-            o=o,
-            d=d,
-            L=jnp.zeros(shape + (3,), jnp.float32),
-            beta=jnp.ones(shape + (3,), jnp.float32),
-            alive=jnp.ones(shape, bool),
             nrays=jnp.zeros(shape, jnp.int32),
-            depth=jnp.zeros(shape, jnp.int32),
-            # MIS state: pdf of the BSDF sample that produced the current
-            # ray; the camera "bounce" counts as specular
-            prev_pdf=jnp.zeros(shape, jnp.float32),
-            specular=jnp.ones(shape, bool),
-            eta_scale=jnp.ones(shape, jnp.float32),
-            prev_p=o,
-            sh_o=o,
-            sh_d=d,
-            sh_dist=jnp.full(shape, -1.0, jnp.float32),
-            ld_pend=jnp.zeros(shape + (3,), jnp.float32),
+            lane=fresh_lanes(o, d),
         )
         out = jax.lax.while_loop(cond, body, init)
-        return out.L, out.nrays
+        return out.lane.L, out.nrays
+
+    # -- persistent wavefront: compaction + regeneration -------------------
+    def pool_chunk(self, dev, fs: FilmState, start_pix, start_s,
+                   n_work: int, pool: int, film=None, cam=None):
+        """Drain work items [start, start + n_work) through a resident
+        pool of `pool` path slots, one bounce per wave.
+
+        Per wave: (1) COMPACT — one packed-int32 single-key sort
+        ((free << 30) | lane, the stream tracer's radix fast path) moves
+        active lanes to a contiguous prefix, free slots to the tail, and
+        every pool array is permuted by the recovered lane index (a
+        nearly-sorted gather: the key is two merged ascending runs);
+        (2) REGENERATE — the free tail takes fresh camera rays from the
+        chunk's work counter, so the trace/shade wave that follows runs
+        near-full; (3) one `_bounce_wave`; (4) DEPOSIT — lanes that
+        finished this wave (dead, no pending shadow) scatter their L into
+        the film state and release their slot. A lane killed with a
+        shadow ray still in flight stays resident one extra wave (the
+        fused layout settles NEE one wave late) before depositing.
+
+        Returns (film_state, rays_traced, live_lane_waves, n_waves,
+        truncated): mean wave occupancy = live_lane_waves / (n_waves *
+        pool); truncated is 1 if the max_waves safety cutoff fired with
+        work still outstanding (the caller warns loudly — a silently
+        darker image must never pass as a completed render).
+        """
+        assert pool < (1 << _POOL_LANE_BITS)
+        film = film if film is not None else self.scene.film
+        cam = cam if cam is not None else self.scene.camera
+        x0, x1, y0, y1 = film.sample_bounds()
+        w = x1 - x0
+        npix = w * (y1 - y0)
+        spp = self.spp
+        motion = "tri_verts1" in dev
+        box_fast = film.pixel_deposit_ok()
+        # worst case: every refill round runs every lane to max_depth,
+        # plus the shadow-settle wave — a static safety bound only
+        max_waves = (n_work // pool + 2) * (self.max_depth + 2) + 8
+
+        class PSt(NamedTuple):
+            fs: FilmState
+            lane: LaneSt
+            px: jnp.ndarray
+            py: jnp.ndarray
+            s: jnp.ndarray
+            wt: jnp.ndarray  # camera ray weight (realistic lens vignetting)
+            time: jnp.ndarray  # per-lane shutter time (motion scenes)
+            has_work: jnp.ndarray  # slot holds an undeposited work item
+            cursor: jnp.ndarray  # work items consumed so far
+            nrays: jnp.ndarray
+            live: jnp.ndarray  # sum of live lanes over waves (occupancy)
+            waves: jnp.ndarray
+
+        def cond(ps: PSt):
+            return ((ps.cursor < n_work) | jnp.any(ps.has_work)) & (
+                ps.waves < max_waves
+            )
+
+        def body(ps: PSt):
+            # ---- compaction: ONE packed-i32 single-key sort ----------
+            lane_idx = jnp.arange(pool, dtype=jnp.int32)
+            key = lane_idx | jnp.where(
+                ps.has_work, 0, jnp.int32(1) << _POOL_LANE_BITS
+            )
+            (key_s,) = jax.lax.sort([key], num_keys=1)
+            perm = key_s & ((1 << _POOL_LANE_BITS) - 1)
+
+            def take(a):
+                return jnp.take(a, perm, axis=0)
+
+            lane = jax.tree.map(take, ps.lane)
+            px, py, s = take(ps.px), take(ps.py), take(ps.s)
+            wt, tl = take(ps.wt), take(ps.time)
+            active = take(ps.has_work)
+            n_live = jnp.sum(active, dtype=jnp.int32)
+
+            # ---- regeneration from the work counter ------------------
+            widx = ps.cursor + (lane_idx - n_live)
+            can = (~active) & (widx < n_work)
+            valid, pxn, pyn, sn, _, o_n, d_n, wt_n = self.work_to_rays(
+                cam, spp, x0, y0, w, npix, start_pix, start_s,
+                jnp.where(can, widx, 0),
+            )
+            can = can & valid
+            fresh = fresh_lanes(o_n, d_n)
+            lane = jax.tree.map(
+                lambda new, old: jnp.where(
+                    can.reshape((pool,) + (1,) * (new.ndim - 1)), new, old
+                ),
+                fresh, lane,
+            )
+            px = jnp.where(can, pxn, px)
+            py = jnp.where(can, pyn, py)
+            s = jnp.where(can, sn, s)
+            wt = jnp.where(can, wt_n, wt)
+            if motion:
+                tl = jnp.where(can, self.u1d(pxn, pyn, sn, DIM_TIME), tl)
+            # the counter also consumes work items whose pixel falls past
+            # the frame (the final chunk's tail) — the fixed-batch loop
+            # likewise masks them out
+            consumed = jnp.clip(n_work - ps.cursor, 0, pool - n_live)
+            has_work = active | can
+
+            live = ps.live + jnp.sum(lane.alive, dtype=jnp.int32)
+
+            # ---- one bounce wave -------------------------------------
+            salt = lane.depth * DIMS_PER_BOUNCE
+            lane, nray_d = self._bounce_wave(
+                dev, px, py, s, salt, tl if motion else None, lane,
+                jnp.zeros((pool,), jnp.int32), fused=True,
+                scalar_bounce=None,
+            )
+
+            # ---- scatter-on-terminate film deposit -------------------
+            done = has_work & ~lane.alive & ~(lane.sh_dist > 0.0)
+            if box_fast:
+                # box(0.5): one masked own-pixel scatter, matching the
+                # aligned path the fixed-batch single-device render uses
+                fs = film.add_samples_pixel(ps.fs, px, py, lane.L, done, wt)
+            else:
+                # general filter footprint: recompute the film jitter
+                # (a pure function of the work item) and mask the
+                # not-yet-terminated lanes out of the crop window
+                fx, fy = self.film_jitter(px, py, s)
+                p_film = jnp.stack(
+                    [px.astype(jnp.float32) + fx,
+                     py.astype(jnp.float32) + fy], axis=-1,
+                )
+                fs = film.add_samples(
+                    ps.fs, jnp.where(done[..., None], p_film, -1e6),
+                    lane.L, wt,
+                )
+            return PSt(
+                fs=fs, lane=lane, px=px, py=py, s=s, wt=wt, time=tl,
+                has_work=has_work & ~done,
+                cursor=ps.cursor + consumed,
+                nrays=ps.nrays + jnp.sum(nray_d),
+                live=live,
+                waves=ps.waves + 1,
+            )
+
+        zero3 = jnp.zeros((pool, 3), jnp.float32)
+        unit_d = jnp.broadcast_to(
+            jnp.asarray([0.0, 0.0, 1.0], jnp.float32), (pool, 3)
+        )
+        init = PSt(
+            fs=fs,
+            lane=fresh_lanes(zero3, unit_d)._replace(
+                alive=jnp.zeros((pool,), bool)
+            ),
+            px=jnp.zeros((pool,), jnp.int32),
+            py=jnp.zeros((pool,), jnp.int32),
+            s=jnp.zeros((pool,), jnp.int32),
+            wt=jnp.zeros((pool,), jnp.float32),
+            time=jnp.zeros((pool,), jnp.float32),
+            has_work=jnp.zeros((pool,), bool),
+            cursor=jnp.int32(0),
+            nrays=jnp.int32(0),
+            live=jnp.int32(0),
+            waves=jnp.int32(0),
+        )
+        out = jax.lax.while_loop(cond, body, init)
+        truncated = (
+            (out.cursor < n_work) | jnp.any(out.has_work)
+        ).astype(jnp.int32)
+        return out.fs, out.nrays, out.live, out.waves, truncated

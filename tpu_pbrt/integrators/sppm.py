@@ -463,7 +463,11 @@ class SPPMIntegrator(WavefrontIntegrator):
         possibly padded state, total photon count)."""
         from functools import partial
 
-        from tpu_pbrt.parallel.mesh import TILE_AXIS, shard_map
+        from tpu_pbrt.parallel.mesh import (
+            SHARD_MAP_NOCHECK,
+            TILE_AXIS,
+            shard_map,
+        )
         from jax.sharding import NamedSharding, PartitionSpec as PS
 
         n_dev = int(mesh.devices.size)
@@ -498,7 +502,7 @@ class SPPMIntegrator(WavefrontIntegrator):
         # cam/photon/gather split: XLA:CPU compile time is superlinear in
         # module size and one fused sharded module takes tens of minutes
         # to build (the split compiles like the single-device modules)
-        sm = partial(shard_map, mesh=mesh, check_vma=False)
+        sm = partial(shard_map, mesh=mesh, **SHARD_MAP_NOCHECK)
 
         @jax.jit
         @partial(

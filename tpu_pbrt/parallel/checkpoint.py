@@ -75,9 +75,14 @@ def load_checkpoint(path: str, fingerprint: str = ""):
                 f"configuration (saved {saved_fp!r}, current {fingerprint!r}); "
                 "delete it or restore the original settings to resume"
             )
+        # jnp.array(copy=True): the render loop DONATES the film state
+        # into its jitted chunk dispatch, so the device arrays must own
+        # their buffers — a zero-copy alias of the numpy arrays here
+        # (jax on CPU aliases host memory) gets freed/overwritten by the
+        # donation and corrupts the heap (flaky resume-test aborts)
         state = FilmState(
-            rgb=jnp.asarray(z["rgb"]),
-            weight=jnp.asarray(z["weight"]),
-            splat=jnp.asarray(z["splat"]),
+            rgb=jnp.array(z["rgb"], copy=True),
+            weight=jnp.array(z["weight"], copy=True),
+            splat=jnp.array(z["splat"], copy=True),
         )
         return state, int(z["next_chunk"]), int(z["rays"])

@@ -32,6 +32,21 @@ try:  # shard_map moved out of experimental in jax 0.8
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
+import inspect
+
+#: kwargs disabling shard_map's replication/varying-manual-axes check —
+#: the BVH while_loop carries start replicated and become varying over
+#: the tile axis, so the check must be off rather than pcast-ing every
+#: loop carry. The kwarg is `check_vma` in jax >= 0.9 and `check_rep`
+#: before; resolve it once against the running version.
+SHARD_MAP_NOCHECK = {
+    (
+        "check_vma"
+        if "check_vma" in inspect.signature(shard_map).parameters
+        else "check_rep"
+    ): False
+}
+
 TILE_AXIS = "tiles"
 
 
@@ -85,15 +100,43 @@ def sharded_chunk_renderer(mesh: Mesh, per_device_fn):
         mesh=mesh,
         in_specs=(P(), P(TILE_AXIS)),
         out_specs=(P(), P()),
-        # the BVH while_loop carry starts replicated and becomes varying
-        # over the tile axis; skip the varying-manual-axes check rather
-        # than pcast every loop carry (jax 0.9 check_vma)
-        check_vma=False,
+        **SHARD_MAP_NOCHECK,
     )
     def step(dev, starts):
         contrib, nrays = per_device_fn(dev, starts)
         contrib = jax.tree.map(lambda x: jax.lax.psum(x, TILE_AXIS), contrib)
         nrays = jax.lax.psum(nrays, TILE_AXIS)
         return contrib, nrays
+
+    return step
+
+
+def sharded_pool_renderer(mesh: Mesh, per_device_drain):
+    """Persistent-wavefront (compaction+regeneration) analog of
+    sharded_chunk_renderer: each device DRAINS its own flat work slice
+    through a resident path pool driven by a per-device work counter,
+    instead of advancing one static batch in lockstep.
+
+    per_device_drain(dev, start_pair) -> (film_contrib pytree, aux pytree)
+    runs the whole drain loop for that device's slice. There are NO
+    collectives inside the drain, so the SPMD while_loops are free to run
+    different iteration counts per device — a device whose paths die
+    early regenerates new pixels from its counter and finishes its slice
+    in fewer waves rather than idling on the longest path; the film psum
+    after the drain is the only sync point. aux (ray/occupancy counters)
+    is psum-reduced alongside the film."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(TILE_AXIS)),
+        out_specs=(P(), P()),
+        **SHARD_MAP_NOCHECK,
+    )
+    def step(dev, starts):
+        contrib, aux = per_device_drain(dev, starts)
+        contrib = jax.tree.map(lambda x: jax.lax.psum(x, TILE_AXIS), contrib)
+        aux = jax.tree.map(lambda x: jax.lax.psum(x, TILE_AXIS), aux)
+        return contrib, aux
 
     return step
