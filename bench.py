@@ -76,6 +76,29 @@ def remaining():
     return BUDGET - (time.time() - T_START)
 
 
+class CompileTracker:
+    """Counts XLA backend compilations and their wall seconds via the
+    supported jax.monitoring event stream (jaxlint ISSUE 2 satellite:
+    bench.py records `jit_recompiles` during the measured leg — any
+    value > 0 means the steady-state number paid hidden compile time —
+    and `compile_seconds` for the whole process)."""
+
+    _EVENT = "/jax/core/compile/backend_compile_duration"
+
+    def __init__(self):
+        import jax.monitoring
+
+        self.compiles = 0
+        self.seconds = 0.0
+
+        def _on_event(event, duration, **kw):
+            if event == CompileTracker._EVENT:
+                self.compiles += 1
+                self.seconds += duration
+
+        jax.monitoring.register_event_duration_secs_listener(_on_event)
+
+
 def compute_mse(mse_res: int, mse_spp: int, ref_spp: int):
     """Accelerator render vs cached CPU reference -> per-pixel MSE, or None
     if the reference cache is missing (generate with tools/make_reference.py)
@@ -131,6 +154,7 @@ def main():
 
     from tpu_pbrt.scenes import compile_api, make_killeroo_like
 
+    tracker = CompileTracker()
     api = make_killeroo_like(res=res, spp=spp)
     scene, integ = compile_api(api)
 
@@ -138,6 +162,7 @@ def main():
     # shapes). Its result doubles as the fallback measurement if compile
     # ate the budget — a compile-tainted number still beats no number.
     result = integ.render(scene, max_seconds=5)
+    compiles_after_warmup = tracker.compiles
     if remaining() > 60:
         # steady-state throughput stabilizes well before completion; box
         # the main leg so the MSE and crown legs fit the total budget
@@ -175,6 +200,16 @@ def main():
         _last_line["mean_wave_occupancy"] = round(float(occ), 4)
         _last_line["trace_waves"] = int(result.stats.get("n_waves", 0))
         _last_line["pool"] = int(result.stats.get("pool", 0))
+    # compile accounting (jaxlint audit's recompile guard, measured in
+    # the judged run): backend compiles during the steady-state leg must
+    # be 0 — the warmup pass owns every legitimate trace for these
+    # shapes. compiles_after_warmup == 0 means the warmup was served
+    # from a persistent compile cache (the event stream only fires on
+    # real backend compiles); flag it so a 0/0 reading is interpretable.
+    _last_line["jit_recompiles"] = tracker.compiles - compiles_after_warmup
+    _last_line["compile_seconds"] = round(tracker.seconds, 2)
+    if compiles_after_warmup == 0:
+        _last_line["compile_cache_warm"] = True
     if not (img_mean > 1e-6):
         _last_line["error"] = "image is black — tracer broken"
 

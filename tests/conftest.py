@@ -48,3 +48,20 @@ _cache_dir = pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"
 _cache_dir.mkdir(exist_ok=True)
 jax.config.update("jax_compilation_cache_dir", str(_cache_dir))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _sync_tpu_pbrt_config():
+    """TPU_PBRT_* knobs are snapshotted at import by tpu_pbrt.config;
+    tests that mutate os.environ mid-test call config.reload() at the
+    mutation point. This autouse resync at both test boundaries keeps a
+    test's leftover env mutations (e.g. monkeypatch teardown, which
+    restores os.environ but knows nothing of the snapshot) from
+    poisoning the knobs later tests see."""
+    from tpu_pbrt import config
+
+    config.reload()
+    yield
+    config.reload()

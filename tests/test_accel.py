@@ -409,9 +409,12 @@ def test_capacity_overflow_detected_and_loud(monkeypatch):
     # stream_traverse_stats reads the env at TRACE time — clear its jit
     # cache so earlier/later same-shape traces cannot leak sizes across
     # the env flip in either direction
+    from tpu_pbrt import config
+
     stream_traverse_stats.clear_cache()
     monkeypatch.setenv("TPU_PBRT_HEADROOM", "0.0")
     monkeypatch.setenv("TPU_PBRT_SLAB", "4096")
+    config.reload()
     api = make_killeroo_like(res=64, spp=2)
     scene, integ = compile_api(api)
     dev = scene.dev
@@ -430,6 +433,7 @@ def test_capacity_overflow_detected_and_loud(monkeypatch):
     # audit seam so this leg does not depend on chunk-size heuristics)
     monkeypatch.delenv("TPU_PBRT_HEADROOM", raising=False)
     monkeypatch.delenv("TPU_PBRT_SLAB", raising=False)
+    config.reload()
     import tpu_pbrt.accel.stream as stream_mod
 
     real_stats = stream_mod.stream_traverse_stats
@@ -441,6 +445,7 @@ def test_capacity_overflow_detected_and_loud(monkeypatch):
     with pytest.raises(RuntimeError, match="dropped 7 traversal pairs"):
         integ2.render(scene2)
     monkeypatch.setenv("TPU_PBRT_ALLOW_DROPS", "1")
+    config.reload()
     res = integ2.render(scene2)
     assert res.completed_fraction == 1.0
     monkeypatch.setattr(stream_mod, "stream_traverse_stats", real_stats)

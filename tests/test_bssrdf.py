@@ -137,3 +137,57 @@ def test_sw_normalization():
     # E_cosine[Sw] * pi = integral Sw cos dw
     integral = sw.mean() * np.pi
     assert abs(integral - 1.0) < 0.02, integral
+
+
+def test_beam_diffusion_ss_exit_fresnel_convention():
+    """BeamDiffusionSS must evaluate the exit Fresnel on the INSIDE-TO-
+    OUTSIDE crossing — pbrt's FrDielectric(-cosThetaO, 1, eta), i.e. the
+    eta -> 1 branch (ISSUE 2 satellite: the entering-side convention
+    (+cos_o) was used, overestimating transmission toward the critical
+    angle). Oracle: re-integrate the single-scatter profile with an
+    explicit exiting-Fresnel term and require exact agreement, and
+    require DISAGREEMENT with the entering-side convention."""
+    import math
+
+    from tpu_pbrt.core.bssrdf import _N_DEPTH, _fr_dielectric, beam_diffusion_ss
+
+    sigma_s, sigma_a, g, eta = 0.8, 0.2, 0.3, 1.5
+    r = np.geomspace(1e-3, 2.0, 24)
+
+    def reference(exit_sign):
+        sigma_t = sigma_a + sigma_s
+        rho = sigma_s / sigma_t
+        t_crit = r * math.sqrt(max(eta * eta - 1.0, 0.0))
+        out = np.zeros_like(r)
+        for i in range(_N_DEPTH):
+            ti = t_crit - math.log(1.0 - (i + 0.5) / _N_DEPTH) / sigma_t
+            d = np.sqrt(r * r + ti * ti)
+            cos_o = ti / np.maximum(d, 1e-9)
+            g2 = g * g
+            denom = 1.0 + g2 + 2.0 * g * (-cos_o)
+            phase = (1.0 - g2) / (4.0 * math.pi * np.maximum(denom, 1e-9) ** 1.5)
+            fr_exit = 1.0 - _fr_dielectric(exit_sign * cos_o, eta)
+            out += (
+                rho * np.exp(-sigma_t * (d + t_crit))
+                / np.maximum(d * d, 1e-12) * phase * fr_exit * cos_o
+            ) / _N_DEPTH
+        return np.maximum(out, 0.0)
+
+    got = beam_diffusion_ss(sigma_s, sigma_a, g, eta, r)
+    np.testing.assert_allclose(got, reference(-1.0), rtol=1e-12)
+    # the two conventions genuinely differ for this medium — the oracle
+    # has teeth
+    assert np.max(np.abs(reference(-1.0) - reference(+1.0))) > 1e-6
+
+
+def test_beam_diffusion_ss_exit_transmission_bounded_by_tir():
+    """With the exiting convention, a chord angle below the critical
+    cosine is fully internally reflected: contributions only flow where
+    1 - Fr(-cos) > 0, so the profile stays finite, nonnegative and
+    decreasing at large radius."""
+    from tpu_pbrt.core.bssrdf import beam_diffusion_ss
+
+    r = np.geomspace(1e-3, 5.0, 40)
+    ss = beam_diffusion_ss(1.0, 0.1, 0.0, 1.5, r)
+    assert np.all(np.isfinite(ss)) and np.all(ss >= 0.0)
+    assert ss[-1] < ss[0]

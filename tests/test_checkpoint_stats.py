@@ -26,6 +26,9 @@ class TestCheckpoint:
         import os
 
         os.environ["TPU_PBRT_CHUNK"] = "1024"  # force multiple chunks
+        from tpu_pbrt import config
+
+        config.reload()
         try:
             api = make_cornell(res=16, spp=8, integrator="directlighting", maxdepth=2)
             scene, integ = compile_api(api)

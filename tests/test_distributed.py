@@ -64,7 +64,10 @@ class TestFaultInjection:
         # small chunks so the render has several dispatches
         import os
 
+        from tpu_pbrt import config
+
         os.environ["TPU_PBRT_CHUNK"] = str(16 * 16 * 2)
+        config.reload()
         try:
             ref = integ.render(scene)
 
@@ -89,7 +92,10 @@ class TestFaultInjection:
 
         import os
 
+        from tpu_pbrt import config
+
         os.environ["TPU_PBRT_CHUNK"] = str(16 * 16 * 2)
+        config.reload()
         try:
             scene, integ = self._scene()
             ref = integ.render(scene)

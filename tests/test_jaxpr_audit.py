@@ -1,0 +1,148 @@
+"""jaxlint layer 2 (jaxpr/compile-time audit): the TPU hot-path
+invariants asserted over the REAL render entry points (ISSUE 2
+acceptance): no f64 in the path-integrator wave, film/pool donation
+materialized as input->output aliasing in the executable, zero retraces
+across two same-shape waves, and a clean smoke render under
+jax.transfer_guard("disallow").
+
+The golden-invariant matrix also covers volpath (homogeneous-medium
+scene), bdpt and both SPPM passes — as of this PR all of them are clean,
+so there are no xfail rows; a future violation fails loudly here and
+must either be fixed or explicitly xfailed with a ROADMAP entry."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_pbrt.analysis import audit
+
+
+# ---------------------------------------------------------------------------
+# detector sanity: the checkers can actually see what they claim to
+# ---------------------------------------------------------------------------
+
+
+def test_find_f64_detects_wide_types():
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        jx = jax.make_jaxpr(
+            lambda x: x.astype(jnp.float64) * 2.0
+        )(jnp.ones((4,), jnp.float32))
+    assert audit.find_f64(jx), "f64 jaxpr not detected"
+
+
+def test_find_f64_clean_on_f32():
+    jx = jax.make_jaxpr(lambda x: x * 2.0)(jnp.ones((4,), jnp.float32))
+    assert audit.find_f64(jx) == []
+
+
+def test_find_callbacks_detects_debug_print():
+    def f(x):
+        jax.debug.print("x={}", x)
+        return x + 1
+
+    jx = jax.make_jaxpr(f)(jnp.float32(1.0))
+    assert audit.find_callbacks(jx), "debug callback not detected"
+
+
+def test_callbacks_seen_inside_while_loop():
+    def f(x):
+        def body(c):
+            jax.debug.print("c={}", c)
+            return c - 1
+
+        return jax.lax.while_loop(lambda c: c > 0, body, x)
+
+    jx = jax.make_jaxpr(f)(jnp.int32(3))
+    assert audit.find_callbacks(jx), "callback inside sub-jaxpr missed"
+
+
+# ---------------------------------------------------------------------------
+# golden jaxpr invariants over the real entry points
+# ---------------------------------------------------------------------------
+
+
+def _assert_clean(name, jx):
+    f64 = audit.find_f64(jx)
+    assert not f64, f"{name}: f64 leaked into the jaxpr: {f64[:5]}"
+    cbs = audit.find_callbacks(jx)
+    assert not cbs, f"{name}: callback primitives in the wave: {cbs}"
+
+
+def test_path_wave_jaxpr_invariants():
+    """ISSUE 2 acceptance: no f64 anywhere in the path-integrator wave."""
+    _assert_clean("path.li", audit.integrator_li_jaxpr("path"))
+
+
+def test_pool_drain_jaxpr_invariants():
+    _assert_clean("pool_chunk", audit.pool_chunk_jaxpr())
+
+
+def test_stream_traversal_jaxpr_invariants():
+    _assert_clean("stream_intersect", audit.stream_traversal_jaxpr())
+
+
+def test_film_deposit_jaxpr_invariants():
+    _assert_clean("film.add_samples", audit.film_deposit_jaxpr())
+    _assert_clean(
+        "film.add_samples_pixel", audit.film_deposit_jaxpr(pixel_path=True)
+    )
+
+
+def test_mesh_step_jaxpr_invariants():
+    _assert_clean("sharded_pool_renderer", audit.mesh_step_jaxpr())
+
+
+def test_volpath_jaxpr_invariants():
+    _assert_clean(
+        "volpath.li", audit.integrator_li_jaxpr("volpath", "media")
+    )
+
+
+def test_bdpt_jaxpr_invariants():
+    _assert_clean("bdpt.li", audit.integrator_li_jaxpr("bdpt", "cornell"))
+
+
+def test_sppm_pass_jaxpr_invariants():
+    cam, photon = audit.sppm_pass_jaxprs()
+    _assert_clean("sppm camera pass", cam)
+    _assert_clean("sppm photon pass", photon)
+
+
+# ---------------------------------------------------------------------------
+# compile-time invariants
+# ---------------------------------------------------------------------------
+
+
+def test_film_donation_materialized():
+    """donate_argnums REQUESTS donation; the invariant is that the
+    compiled executable actually aliases every film buffer input to an
+    output (PR 1's donated-alias incident is the motivating example)."""
+    assert audit.check_film_donation() == []
+
+
+def test_zero_retraces_across_same_shape_waves():
+    assert audit.check_recompile_guard() == []
+
+
+def test_smoke_render_under_transfer_guard():
+    assert audit.check_transfer_guard() == []
+
+
+def test_donation_alias_counter_reads_hlo():
+    txt = (
+        "HloModule jit_f, is_scheduled=true, "
+        "input_output_alias={ {0}: (0, {}, may-alias), "
+        "{1}: (1, {}, may-alias) }, entry_computation_layout=..."
+    )
+    assert audit.donation_aliases(txt) == 2
+    assert audit.donation_aliases("HloModule jit_f") == 0
+
+
+def test_run_audit_aggregates_clean():
+    """The CLI path: every audit passes on the shipped tree. Compile
+    checks are exercised individually above; keep this to the pure-trace
+    set so the aggregate stays cheap under pytest."""
+    fails = audit.run_audit(include_compile=False)
+    assert fails == [], "\n".join(fails)

@@ -48,6 +48,9 @@ WorldEnd
             os.environ["TPU_PBRT_MIPFILTER"] = "0"
         else:
             os.environ.pop("TPU_PBRT_MIPFILTER", None)
+        from tpu_pbrt import config
+
+        config.reload()
         res = tpu_pbrt.render_file(path)
         return np.asarray(res.image)
     finally:

@@ -21,8 +21,11 @@ from tpu_pbrt.scenes import compile_api, make_killeroo_like
 
 
 def _render(spp, env, maxdepth=5):
+    from tpu_pbrt import config
+
     old = {k: os.environ.get(k) for k in env}
     os.environ.update(env)
+    config.reload()
     try:
         api = make_killeroo_like(
             res=32, spp=spp, maxdepth=maxdepth, n_theta=24, n_phi=48
@@ -35,6 +38,7 @@ def _render(spp, env, maxdepth=5):
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+        config.reload()
 
 
 def test_regen_image_bit_identical_at_spp1():

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Local CI gate (ISSUE 2 satellite): ruff -> jaxlint -> tier-1 pytest.
+#
+#   tools/ci.sh            # full gate
+#   tools/ci.sh --fast     # skip the pytest leg (lint + audit only)
+#
+# ruff is optional in minimal containers (the image does not bake it);
+# the repo-specific invariants are enforced by `python -m
+# tpu_pbrt.analysis` regardless.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== ruff"
+if command -v ruff >/dev/null 2>&1; then
+    ruff check tpu_pbrt tests bench.py
+elif python -c "import ruff" >/dev/null 2>&1; then
+    python -m ruff check tpu_pbrt tests bench.py
+else
+    echo "   ruff not installed — skipping (pip install ruff to enable)"
+fi
+
+echo "== jaxlint (python -m tpu_pbrt.analysis)"
+python -m tpu_pbrt.analysis
+
+if [[ "${1:-}" == "--fast" ]]; then
+    echo "== pytest skipped (--fast)"
+    exit 0
+fi
+
+echo "== tier-1 pytest"
+python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider

@@ -84,8 +84,12 @@ def main():
     t_sh, _ = timeit(stream_intersect_p, tp, o2, d2, 1e6)
     print(f"incoherent any-hit: {t_sh*1e3:.1f} ms -> {R/t_sh/1e6:.2f} Mray/s")
 
-    # full path chunk at the bench's chunk size
+    # full path chunk at the bench's chunk size (env knobs are
+    # snapshotted at import by tpu_pbrt.config — resync after mutating)
     os.environ.setdefault("TPU_PBRT_CHUNK", str(R))
+    from tpu_pbrt import config
+
+    config.reload()
     t0 = time.time()
     res = integ.render(scene, max_seconds=30)
     print(f"path render 30s-box: {res.mray_per_sec:.2f} Mray/s "

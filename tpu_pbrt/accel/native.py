@@ -50,7 +50,9 @@ def _compile() -> bool:
 def get_lib():
     """The loaded native library, or None (numpy fallback)."""
     global _lib, _tried
-    if os.environ.get("TPU_PBRT_NATIVE", "1") == "0":
+    from tpu_pbrt.config import cfg
+
+    if not cfg.native:
         return None
     with _lock:
         if _tried:

@@ -76,7 +76,7 @@ def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool):
     L = tp.leaf_tris
     inv_d = 1.0 / d
     dead = t_max <= 0.0
-    p_idx = jnp.arange(P)
+    p_idx = jnp.arange(P, dtype=jnp.int32)
 
     top = tp.top
     from tpu_pbrt.accel.treelet import decode_top_leaf
@@ -159,7 +159,8 @@ def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool):
     def flush(s: _State):
         """Sort the leaf queue by entry distance, intersect front-to-back."""
         key = jnp.where(
-            jnp.arange(LEAF_QUEUE)[None, :] < s.nleaf[:, None], s.leaf_tn, jnp.inf
+            jnp.arange(LEAF_QUEUE, dtype=jnp.int32)[None, :] < s.nleaf[:, None],
+            s.leaf_tn, jnp.inf
         )
         key_s, id_s = jax.lax.sort([key, s.leaf_id], num_keys=1)
         s = s._replace(leaf_tn=key_s, leaf_id=id_s)

@@ -94,6 +94,7 @@ import numpy as np
 
 from tpu_pbrt.accel.mxu import decode_outputs
 from tpu_pbrt.accel.traverse import Hit
+from tpu_pbrt.config import cfg
 from tpu_pbrt.accel.treelet import TreeletPack, decode_top_leaf
 from tpu_pbrt.accel.wide import _EMPTY, slab_test_lane_major
 
@@ -122,9 +123,7 @@ def _use_pallas() -> bool:
     """Static (trace-time) switch: the fused Pallas leaf kernel runs on
     real TPUs; CPU (tests, virtual meshes) uses the XLA einsum fallback.
     TPU_PBRT_PALLAS=0 forces the fallback for A/B comparison."""
-    import os
-
-    if os.environ.get("TPU_PBRT_PALLAS", "1") == "0":
+    if not cfg.pallas:
         return False
     return jax.default_backend() not in ("cpu",)
 
@@ -133,15 +132,11 @@ def _use_prefetch() -> bool:
     """Opt-in scalar-prefetch leaf kernel (TPU_PBRT_PREFETCH=1): DMAs
     treelet rows in-kernel instead of a materialized gather. Verified
     bit-compatible; currently ~15% slower end-to-end (see _flush)."""
-    import os
-
-    return os.environ.get("TPU_PBRT_PREFETCH", "0") == "1"
+    return cfg.prefetch
 
 
 def _use_onehot(n_nodes: int) -> bool:
-    import os
-
-    if os.environ.get("TPU_PBRT_ONEHOT", "1") == "0":
+    if not cfg.onehot:
         return False
     return n_nodes <= _ONEHOT_MAX_NODES
 
@@ -179,16 +174,14 @@ def _sizes(R: int):
     per-ray closest-t stays loose longer and the wave expands more
     pairs. The default keeps the tighter-culling small slab;
     TPU_PBRT_SLAB overrides for experiments."""
-    import os
-
-    cap = int(os.environ.get("TPU_PBRT_SLAB", 1 << 17))
+    cap = int(cfg.slab)
     slab = int(min(max(R // 4, 4096), cap))
     # TPU_PBRT_HEADROOM scales the worklist headroom (default 1.0);
     # the capacity-overflow regression test shrinks it to force drops.
     # Floors: the stack must hold at least one push burst, and the leaf
     # buffer must exceed the 8*slab flush threshold or _traverse would
     # flush empty buffers forever.
-    head = float(os.environ.get("TPU_PBRT_HEADROOM", "1.0"))
+    head = float(cfg.headroom)
     w = R + max(int(24 * slab * head), slab // 2)
     lb = max(int(12 * slab * head), 9 * slab)
     return slab, w, lb

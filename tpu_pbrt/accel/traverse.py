@@ -128,11 +128,11 @@ def brute_force_intersect(tri_verts, o, d, t_max, chunk=4096):
         hit, t, b0, b1 = intersect_triangle(
             o[:, None, :], d[:, None, :], tv[None, :, 0], tv[None, :, 1], tv[None, :, 2], t_best[:, None]
         )
-        tri_ids = start + jnp.arange(chunk)
+        tri_ids = start + jnp.arange(chunk, dtype=jnp.int32)
         valid = hit & (tri_ids[None, :] < n_tris)
         t = jnp.where(valid, t, jnp.inf)
         k = jnp.argmin(t, axis=1)
-        rr = jnp.arange(r)
+        rr = jnp.arange(r, dtype=jnp.int32)
         better = t[rr, k] < t_best
         return (
             jnp.where(better, t[rr, k], t_best),

@@ -244,7 +244,7 @@ def _ray_traverse_wide(w: WideBVH, tri_flat, o, d, t_max, any_hit: bool):
         h, th, b0h, b1h = intersect_triangle(
             o, d, tri_block[:, 0], tri_block[:, 1], tri_block[:, 2], s.t
         )
-        take = is_leaf & (jnp.arange(MAX_LEAF_PRIMS) < cnt) & h
+        take = is_leaf & (jnp.arange(MAX_LEAF_PRIMS, dtype=jnp.int32) < cnt) & h
         th_m = jnp.where(take, th, jnp.inf)
         k = jnp.argmin(th_m)
         better = th_m[k] < s.t

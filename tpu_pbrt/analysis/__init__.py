@@ -1,0 +1,29 @@
+"""jaxlint — repo-specific static analysis + jaxpr audit for TPU hot paths.
+
+Two layers (ISSUE 2):
+
+- **Layer 1 (AST lint, `lint.py`)**: syntactic rules over the source tree.
+  A per-module call graph seeded at `jax.jit` / `lax.while_loop` /
+  `shard_map` boundaries marks *traced* functions, and the hot-path rules
+  (host syncs, f64 leaks, dtype-less constructors, captured-array
+  mutation) fire only inside them, so host-side driver/build code stays
+  lintable Python. `# jaxlint: disable=RULE` pragmas suppress per line.
+
+- **Layer 2 (jaxpr/compile audit, `audit.py`)**: traces the real render
+  entry points (path bounce wave, persistent pool drain, stream
+  traversal, film deposit, sharded mesh step) and asserts over the jaxpr
+  and the compiled executable: no f64 anywhere, no callback primitives,
+  donation materialized as input->output aliasing for the film/pool
+  buffers, zero retraces across same-shape waves, and a clean smoke
+  render under jax.transfer_guard("disallow").
+
+Run `python -m tpu_pbrt.analysis` (see `__main__.py`), or the pytest
+mirrors in tests/test_jaxlint.py and tests/test_jaxpr_audit.py.
+"""
+
+from tpu_pbrt.analysis.lint import (  # noqa: F401
+    RULES,
+    Violation,
+    lint_file,
+    lint_tree,
+)

@@ -1,0 +1,98 @@
+"""`python -m tpu_pbrt.analysis` — run the jaxlint suite.
+
+Layer 1 (AST lint) always runs; layer 2 (jaxpr/compile audit) runs unless
+--no-audit (it compiles small render programs, a few seconds on CPU).
+Exit code 0 iff no error-severity findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tpu_pbrt.analysis")
+    ap.add_argument(
+        "paths", nargs="*", help="files to lint (default: all of tpu_pbrt/)"
+    )
+    ap.add_argument(
+        "--no-audit", action="store_true",
+        help="skip the jaxpr/compile-time audit layer",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    from tpu_pbrt.analysis.lint import PRAGMA_BUDGET, lint_tree
+
+    repo_root = Path(__file__).resolve().parents[2]
+    paths = [Path(p).resolve() for p in args.paths] or None
+    violations, pragmas = lint_tree(repo_root, paths)
+    over_budget = paths is None and pragmas > PRAGMA_BUDGET
+
+    audit_failures = []
+    if not args.no_audit:
+        # CPU audit runs compile tiny programs; the unoptimized XLA
+        # pipeline + the repo compilation cache keep this to seconds.
+        # Must happen before jax initializes a backend.
+        import os
+
+        # only when the operator EXPLICITLY selected cpu (tools/ci.sh
+        # does): unset JAX_PLATFORMS on a TPU VM means a TPU backend,
+        # which must not inherit the unoptimized-CPU pipeline flag
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_backend_optimization_level" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_backend_optimization_level=0"
+                ).strip()
+        import jax
+
+        cache = repo_root / ".jax_cache"
+        if cache.is_dir():
+            jax.config.update("jax_compilation_cache_dir", str(cache))
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0
+            )
+
+        from tpu_pbrt.analysis.audit import run_audit
+
+        audit_failures = run_audit()
+
+    errors = [v for v in violations if v.severity == "error"]
+    ok = not errors and not audit_failures and not over_budget
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "lint": [v.__dict__ for v in violations],
+                    "audit": audit_failures,
+                    "pragmas": pragmas,
+                    "pragma_budget": PRAGMA_BUDGET,
+                    "ok": ok,
+                }
+            )
+        )
+    else:
+        for v in violations:
+            print(v)
+        for f in audit_failures:
+            print(f"AUDIT: {f}")
+        n_warn = len(violations) - len(errors)
+        print(
+            f"jaxlint: {len(errors)} error(s), {n_warn} warning(s), "
+            f"{len(audit_failures)} audit failure(s), "
+            f"{pragmas} pragma suppression(s) (budget {PRAGMA_BUDGET})"
+        )
+        if over_budget:
+            print(
+                f"jaxlint: pragma budget exceeded ({pragmas} > "
+                f"{PRAGMA_BUDGET}) — fix the code instead of suppressing"
+            )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

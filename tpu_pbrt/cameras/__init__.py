@@ -172,7 +172,7 @@ def _screen_area_z1(cam: CompiledCamera):
     """Area of the perspective screen window projected to the z=1 plane in
     camera space (perspective.cpp PerspectiveCamera constructor's A)."""
     rx, ry = cam.full_res
-    corners = jnp.asarray([[0.0, 0.0, 0.0], [float(rx), float(ry), 0.0]], jnp.float32)
+    corners = jnp.asarray([[0.0, 0.0, 0.0], [rx, ry, 0.0]], jnp.float32)
     p = _xform_point(cam.raster_to_camera, corners)
     p = p / p[:, 2:3]
     return jnp.abs((p[1, 0] - p[0, 0]) * (p[1, 1] - p[0, 1]))
@@ -256,8 +256,8 @@ def generate_rays(cam: CompiledCamera, p_film, u_lens):
         lens = cam.lens
         rx, ry = cam.full_res
         a = ry / rx
-        fx = float(np.sqrt(lens.film_diag**2 / (1.0 + a * a)))
-        fy = a * fx
+        fx = np.float32(np.sqrt(lens.film_diag**2 / (1.0 + a * a)))
+        fy = np.float32(a * fx)
         sx = p_film[..., 0] / rx
         sy = p_film[..., 1] / ry
         pf = jnp.stack(

@@ -1,0 +1,134 @@
+"""Centralized runtime configuration — the ONLY sanctioned os.environ
+reader inside tpu_pbrt/ (enforced by jaxlint rule JL-ENV).
+
+Every TPU_PBRT_* knob the renderer honors is read ONCE, here, at import
+time into the module-level `cfg` singleton. Hot modules import `cfg` and
+read plain attributes — no scattered `os.environ.get` calls inside
+jit-reachable code, no per-call string parsing, and one place to see the
+whole knob surface.
+
+Tests that need to flip a knob mid-process set the env var and call
+`reload()` (see tests/conftest.py's `tpu_pbrt_env` helper); production
+code must never call reload() — the snapshot taken at import is the
+contract.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+_FALSY = frozenset({"0", "false", "no", "off"})
+_TRUTHY = frozenset({"1", "true", "yes", "on"})
+
+
+def _flag(name: str, default: bool) -> bool:
+    """Explicit falsy/truthy spellings only; unset, empty, or anything
+    unrecognized keeps the default. `export KNOB=` or `KNOB=false` in a
+    wrapper script must never count as enabled — TPU_PBRT_ALLOW_DROPS
+    silently flipping on would downgrade the capacity-overflow error to
+    a warning (silent false misses)."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    v = v.strip().lower()
+    if v in _FALSY:
+        return False
+    if v in _TRUTHY:
+        return True
+    return default
+
+
+def _int(name: str, default: Optional[int]) -> Optional[int]:
+    v = os.environ.get(name)
+    return default if v in (None, "") else int(v)
+
+
+def _float(name: str, default: Optional[float]) -> Optional[float]:
+    v = os.environ.get(name)
+    return default if v in (None, "") else float(v)
+
+
+class Config:
+    """Snapshot of every environment knob. Attributes only — no methods
+    touch os.environ after _load()."""
+
+    __slots__ = (
+        "bvh",
+        "leaf_tris",
+        "pallas",
+        "prefetch",
+        "onehot",
+        "slab",
+        "headroom",
+        "native",
+        "progress_frequency",
+        "coordinator_address",
+        "regen",
+        "mipfilter",
+        "chunk",
+        "pool",
+        "audit_drops",
+        "allow_drops",
+    )
+
+    def _load(self) -> "Config":
+        #: acceleration structure: stream (default) | packet | wide | binary
+        self.bvh: str = os.environ.get("TPU_PBRT_BVH", "stream")
+        #: triangles per stream-path treelet leaf (None -> STREAM_LEAF_TRIS)
+        self.leaf_tris: Optional[int] = _int("TPU_PBRT_LEAF_TRIS", None)
+        #: fused Pallas leaf kernel on real TPUs (0 forces the XLA einsum)
+        self.pallas: bool = _flag("TPU_PBRT_PALLAS", True)
+        #: opt-in scalar-prefetch leaf kernel variant
+        self.prefetch: bool = _flag("TPU_PBRT_PREFETCH", False)
+        #: one-hot MXU matmul for small-table gathers in EXPAND
+        self.onehot: bool = _flag("TPU_PBRT_ONEHOT", True)
+        #: stream worklist slab cap (pairs per EXPAND step)
+        self.slab: int = _int("TPU_PBRT_SLAB", 1 << 17)
+        #: worklist headroom scale (the overflow regression test shrinks it)
+        self.headroom: float = _float("TPU_PBRT_HEADROOM", 1.0)
+        #: native C++ scene-compile helpers (0 forces the numpy builders)
+        self.native: bool = _flag("TPU_PBRT_NATIVE", True)
+        #: progress-bar min update interval in seconds (pbrt's knob name)
+        self.progress_frequency: Optional[float] = _float(
+            "PBRT_PROGRESS_FREQUENCY", None
+        )
+        #: multi-host coordinator snapshot; prefer coordinator_address()
+        #: (call-time) — drivers commonly export the variable AFTER
+        #: import, once cluster discovery has run
+        self.coordinator_address: Optional[str] = os.environ.get(
+            "JAX_COORDINATOR_ADDRESS"
+        )
+        #: persistent-wavefront compaction+regeneration (0 -> fixed batch)
+        self.regen: bool = _flag("TPU_PBRT_REGEN", True)
+        #: trilinear mip selection from camera-ray differentials
+        self.mipfilter: bool = _flag("TPU_PBRT_MIPFILTER", True)
+        #: camera rays per dispatch (None -> platform default)
+        self.chunk: Optional[int] = _int("TPU_PBRT_CHUNK", None)
+        #: path-pool slots (0 -> per_dev/4 heuristic)
+        self.pool: int = _int("TPU_PBRT_POOL", 0)
+        #: pre-render stream-capacity audit (overflows fail loudly)
+        self.audit_drops: bool = _flag("TPU_PBRT_AUDIT_DROPS", True)
+        #: downgrade a detected capacity overflow to a warning
+        self.allow_drops: bool = _flag("TPU_PBRT_ALLOW_DROPS", False)
+        return self
+
+
+#: the process-wide snapshot, read once at import
+cfg = Config()._load()
+
+
+def reload() -> Config:
+    """Re-read the environment into the existing `cfg` object (same
+    identity, so `from tpu_pbrt.config import cfg` holders see the new
+    values). Test-only seam."""
+    return cfg._load()
+
+
+def coordinator_address() -> Optional[str]:
+    """JAX_COORDINATOR_ADDRESS at CALL time. Unlike the TPU_PBRT_*
+    knobs, this standard JAX cluster variable is routinely exported by
+    launch drivers after import (post cluster discovery), so the
+    import-time snapshot contract does not apply to it."""
+    return os.environ.get("JAX_COORDINATOR_ADDRESS") or cfg.coordinator_address

@@ -168,7 +168,12 @@ def beam_diffusion_ss(sigma_s: float, sigma_a: float, g: float, eta: float,
         g2 = g * g
         denom = 1.0 + g2 + 2.0 * g * (-cos_o)
         phase = (1.0 - g2) / (4.0 * math.pi * np.maximum(denom, 1e-9) ** 1.5)
-        fr_exit = 1.0 - _fr_dielectric(cos_o, eta)
+        # exit Fresnel at the inside-to-outside crossing: pbrt's
+        # BeamDiffusionSS uses FrDielectric(-cosThetaO, 1, eta) — the
+        # NEGATIVE cosine selects the eta->1 (exiting) branch. The
+        # entering-side convention (+cos_o) overestimates transmission
+        # near the critical angle (advisor finding, ISSUE 2 satellite)
+        fr_exit = 1.0 - _fr_dielectric(-cos_o, eta)
         out += (
             rho
             * np.exp(-sigma_t * (d + t_crit))

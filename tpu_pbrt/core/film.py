@@ -18,6 +18,7 @@ AtomicFloat splats (SURVEY.md §5.2).
 from __future__ import annotations
 
 import math
+from functools import partial
 from typing import NamedTuple, Optional
 
 import jax
@@ -41,6 +42,15 @@ class FilmState(NamedTuple):
 def merge_film(a: FilmState, b: FilmState) -> FilmState:
     """Film::MergeFilmTile, functional form."""
     return FilmState(a.rgb + b.rgb, a.weight + b.weight, a.splat + b.splat)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _init_state_jit(ry: int, rx: int) -> FilmState:
+    return FilmState(
+        rgb=jnp.zeros((ry, rx, 3), jnp.float32),
+        weight=jnp.zeros((ry, rx), jnp.float32),
+        splat=jnp.zeros((ry, rx, 3), jnp.float32),
+    )
 
 
 class Film:
@@ -101,11 +111,11 @@ class Film:
     # -- device ops -------------------------------------------------------
     def init_state(self) -> FilmState:
         rx, ry = self.full_resolution
-        return FilmState(
-            rgb=jnp.zeros((ry, rx, 3), jnp.float32),
-            weight=jnp.zeros((ry, rx), jnp.float32),
-            splat=jnp.zeros((ry, rx, 3), jnp.float32),
-        )
+        # jitted creation: eager jnp.zeros stages an implicit
+        # host->device scalar transfer, which the jaxpr audit's
+        # transfer_guard("disallow") smoke render treats as an error;
+        # inside jit the zeros are compile-time constants
+        return _init_state_jit(ry, rx)
 
     def add_samples(self, state: FilmState, p_film, L, ray_weight=None) -> FilmState:
         """FilmTile::AddSample over a batch. p_film: (R,2) raster coords,
@@ -195,7 +205,7 @@ class Film:
         n = L.shape[0]
         npc = n // spp
         contrib = L.reshape(npc, spp, 3).sum(axis=1)
-        wadd = jnp.full((npc,), jnp.float32(spp))
+        wadd = jnp.full((npc,), spp, dtype=jnp.float32)
         rx, ry = self.full_resolution
         rgb_flat = state.rgb.reshape(rx * ry, 3)
         w_flat = state.weight.reshape(rx * ry)
@@ -282,9 +292,12 @@ class Film:
     def develop(self, state: FilmState, splat_scale: float = 1.0) -> np.ndarray:
         """Film::WriteImage math: rgb/filterWeightSum + splatScale*splat,
         then `scale`. Returns the cropped (h, w, 3) float32 image."""
-        rgb = np.asarray(state.rgb, np.float64)
-        w = np.asarray(state.weight, np.float64)
-        splat = np.asarray(state.splat, np.float64)
+        # explicit device_get: develop() runs inside the render loop's
+        # jax.transfer_guard("disallow") audit, where an implicit D2H
+        # (np.asarray on a device buffer) is a hard error
+        rgb = np.asarray(jax.device_get(state.rgb), np.float64)
+        w = np.asarray(jax.device_get(state.weight), np.float64)
+        splat = np.asarray(jax.device_get(state.splat), np.float64)
         img = rgb / np.maximum(w, 1e-20)[..., None]
         img = np.where(w[..., None] > 0, img, 0.0)
         img = img + splat_scale * splat

@@ -410,7 +410,7 @@ class _RemapTables:
     cache: dict = {}
 
     @classmethod
-    def get(cls, m):
+    def get(cls, m):  # jaxlint: disable=JL-SYNC,JL-MUT — host table bake
         if m in cls.cache:
             return cls.cache[m]
         # rows: for each low index bit c < 2m, the (x|y) bits it produces

@@ -526,7 +526,7 @@ def build_texture_table(nodes: List[Any]) -> Tuple[np.ndarray, Callable]:
         for i, fn in enumerate(fns):
             val = fn(atlas_buf, uv, p, lod)
             if val.ndim == out.ndim - 1:
-                val = val[..., None] * jnp.ones(3)
+                val = val[..., None] * jnp.ones((3,), jnp.float32)
             out = jnp.where((tid == i)[..., None], val, out)
         return out
 

@@ -38,6 +38,7 @@ from tpu_pbrt.accel.wide import wide_intersect, wide_intersect_p
 
 
 from tpu_pbrt.cameras import generate_rays
+from tpu_pbrt.config import cfg
 from tpu_pbrt.core import bxdf
 from tpu_pbrt.core import lights_dev as ld
 from tpu_pbrt.core.film import FilmState
@@ -714,11 +715,10 @@ class WavefrontIntegrator:
         # (tests) prefers smaller programs to bound compile time.
         is_tpu = jax.devices()[0].platform != "cpu"
         if is_tpu:
-            accel = _os.environ.get("TPU_PBRT_BVH", "stream")
-            default_chunk = (1 << 20) if accel == "stream" else (1 << 13)
+            default_chunk = (1 << 20) if cfg.bvh == "stream" else (1 << 13)
         else:
             default_chunk = min(MAX_RAYS_PER_DISPATCH >> 1, 1 << 17)
-        chunk = int(_os.environ.get("TPU_PBRT_CHUNK", default_chunk))
+        chunk = int(cfg.chunk if cfg.chunk is not None else default_chunk)
         chunk = min(chunk, max(1024 * n_dev, total))
         chunk = (chunk // n_dev) * n_dev
         per_dev = chunk // n_dev
@@ -734,7 +734,7 @@ class WavefrontIntegrator:
         use_regen = self._regen_enabled()
         pool = 0
         if use_regen:
-            pool = int(_os.environ.get("TPU_PBRT_POOL", "0"))
+            pool = int(cfg.pool)
             if pool <= 0:
                 pool = max(per_dev // 4, min(per_dev, 4096))
             pool = min(pool, per_dev)
@@ -851,15 +851,24 @@ class WavefrontIntegrator:
                 jfn = jax.jit(chunk_fn, donate_argnums=(0,))
             self._jit_cache = (jit_key, jfn)
 
+        # start cursors move host->device once per chunk; the transfer is
+        # EXPLICIT (device_put) so the whole loop runs clean under
+        # jax.transfer_guard("disallow") — the jaxpr audit's smoke render
         if mesh is None:
             starts = [
-                tuple(jnp.int32(v) for v in split_start(c * chunk)) for c in range(n_chunks)
+                tuple(
+                    jax.device_put(np.int32(v))
+                    for v in split_start(c * chunk)
+                )
+                for c in range(n_chunks)
             ]
         else:
             starts = []
             for c in range(n_chunks):
                 pairs = [split_start(c * chunk + i * per_dev) for i in range(n_dev)]
-                starts.append(jnp.asarray(pairs, jnp.int32))  # (n_dev, 2)
+                starts.append(
+                    jax.device_put(np.asarray(pairs, np.int32))
+                )  # (n_dev, 2)
 
         # -- checkpoint/resume (SURVEY.md §5.4): film accumulation is
         # associative and chunks are idempotent, so a checkpoint is just
@@ -877,7 +886,7 @@ class WavefrontIntegrator:
         if ckpt_path and _os.path.exists(ckpt_path):
             state, first_chunk, prev_rays = load_checkpoint(ckpt_path, fp)
 
-        if _os.environ.get("TPU_PBRT_AUDIT_DROPS", "1") != "0" and "tstream" in dev:
+        if cfg.audit_drops and "tstream" in dev:
             # Capacity audit, DEFAULT ON, BEFORE the render loop (an
             # overflow must fail in seconds, not after the full render
             # has been paid for): the stream
@@ -891,20 +900,46 @@ class WavefrontIntegrator:
             # chunk size. TPU_PBRT_AUDIT_DROPS=0 opts out.
             from tpu_pbrt.accel.stream import stream_traverse_stats
 
-            k = jnp.arange(min(chunk, total), dtype=jnp.int32)
-            pix = k // spp
-            p_film0 = jnp.stack(
-                [(x0 + pix % w).astype(jnp.float32) + 0.5,
-                 (y0 + pix // w).astype(jnp.float32) + 0.5], axis=-1)
-            o0, d0, _ = generate_rays(cam, p_film0, jnp.zeros_like(p_film0))
-            *_, drops, _ = stream_traverse_stats(dev["tstream"], o0, d0, jnp.inf)
-            if int(drops) > 0:
+            audit_key = (scene, chunk)
+            cached_audit = getattr(self, "_audit_jit", None)
+            if (
+                cached_audit is not None
+                and cached_audit[0][0] is scene
+                and cached_audit[0][1] == chunk
+            ):
+                audit_rays = cached_audit[1]
+            else:
+
+                @jax.jit
+                def audit_rays():
+                    # staged under jit: eager array creation would be an
+                    # implicit transfer under the audit's transfer guard.
+                    # Cached across render() calls (like the chunk
+                    # closure) so repeat renders stay at 0 recompiles.
+                    k = jnp.arange(min(chunk, total), dtype=jnp.int32)
+                    pix = k // spp
+                    p_film0 = jnp.stack(
+                        [(x0 + pix % w).astype(jnp.float32) + 0.5,
+                         (y0 + pix // w).astype(jnp.float32) + 0.5], axis=-1)
+                    o0, d0, _ = generate_rays(
+                        cam, p_film0, jnp.zeros_like(p_film0)
+                    )
+                    return o0, d0
+
+                self._audit_jit = (audit_key, audit_rays)
+
+            o0, d0 = audit_rays()
+            *_, drops, _ = stream_traverse_stats(
+                dev["tstream"], o0, d0, jax.device_put(np.float32(np.inf))
+            )
+            drops = int(jax.device_get(drops))
+            if drops > 0:
                 msg = (
-                    f"stream tracer dropped {int(drops)} traversal pairs to "
+                    f"stream tracer dropped {drops} traversal pairs to "
                     "capacity on the camera wave — the render may have false "
                     "misses; lower TPU_PBRT_CHUNK or raise TPU_PBRT_HEADROOM"
                 )
-                if _os.environ.get("TPU_PBRT_ALLOW_DROPS") == "1":
+                if cfg.allow_drops:
                     from tpu_pbrt.utils.error import Warning as _W
 
                     _W(msg)
@@ -981,7 +1016,8 @@ class WavefrontIntegrator:
                         ckpt_path,
                         state,
                         c,
-                        prev_rays + sum(int(r) for r in ray_counts),
+                        prev_rays
+                        + sum(int(r) for r in jax.device_get(ray_counts)),
                         fingerprint=fp,
                     )
                 if max_seconds > 0:
@@ -1009,7 +1045,7 @@ class WavefrontIntegrator:
         secs = time.time() - t0
         progress.done()
         completed_fraction = chunks_done / max(n_chunks, 1)
-        rays = prev_rays + int(sum(int(r) for r in ray_counts))
+        rays = prev_rays + int(sum(int(r) for r in jax.device_get(ray_counts)))
         STATS.counter("Integrator/Rays traced", rays)
         STATS.counter("Integrator/Camera rays traced", total)
         STATS.distribution("Integrator/Rays per camera ray", rays / max(total, 1))
@@ -1031,9 +1067,10 @@ class WavefrontIntegrator:
                 _W(f"could not write image {film.filename}: {e}")
         stats: Dict[str, Any] = {}
         if use_regen and occ_counts:
-            lv_t = sum(int(a) for a, _, _ in occ_counts)
-            wv_t = sum(int(b) for _, b, _ in occ_counts)
-            tr_t = sum(int(t) for _, _, t in occ_counts)
+            occ_host = jax.device_get(occ_counts)
+            lv_t = sum(int(a) for a, _, _ in occ_host)
+            wv_t = sum(int(b) for _, b, _ in occ_host)
+            tr_t = sum(int(t) for _, _, t in occ_host)
             if tr_t:
                 # the pool's max_waves safety cutoff fired with work still
                 # outstanding — a silently darker image must never pass as

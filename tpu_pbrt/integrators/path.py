@@ -137,9 +137,9 @@ class PathIntegrator(WavefrontIntegrator):
         wave layout (single-segment visibility, no null passthrough) and
         a sampler whose dimension salts work per-lane (halton's pair
         dispatch is a lax.switch on the salt and needs it scalar)."""
-        import os
+        from tpu_pbrt.config import cfg
 
-        if os.environ.get("TPU_PBRT_REGEN", "1") == "0":
+        if not cfg.regen:
             return False
         if self.vis_segments != 1 or self.margin != 0:
             return False
@@ -202,10 +202,10 @@ class PathIntegrator(WavefrontIntegrator):
         # interaction.cpp ComputeDifferentials); bounce>0 vertices
         # shade at the finest level, as pbrt does for non-specular
         # continuations
-        import os as _os
+        from tpu_pbrt.config import cfg
 
         if (self.tex_eval is not None and "tri_difT" in dev
-                and _os.environ.get("TPU_PBRT_MIPFILTER", "1") != "0"):
+                and cfg.mipfilter):
             from tpu_pbrt.cameras import ray_differentials
 
             def cam_footprint(args):

@@ -58,10 +58,10 @@ def maybe_init_distributed(options=None) -> bool:
     pod env) or options.multihost is set. Idempotent; returns whether the
     distributed runtime is live. After this, jax.devices() spans all
     hosts and the same shard_map program runs pod-wide."""
-    import os
+    from tpu_pbrt.config import coordinator_address
 
     want = bool(getattr(options, "multihost", False)) or bool(
-        os.environ.get("JAX_COORDINATOR_ADDRESS")
+        coordinator_address()
     )
     if not want:
         return False

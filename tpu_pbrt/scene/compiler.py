@@ -1565,7 +1565,9 @@ def compile_scene(api) -> CompiledScene:
         dev["tex_atlas"] = jnp.asarray(tex_atlas, jnp.float32)
     if light_atlas_chunks:
         dev["light_atlas"] = jnp.asarray(light_atlas, jnp.float32)
-    accel_kind = _os.environ.get("TPU_PBRT_BVH", "stream")
+    from tpu_pbrt.config import cfg
+
+    accel_kind = cfg.bvh
     if verts1 is not None and accel_kind in ("binary", "wide"):
         Warning(
             "motion blur is only supported on the stream/brute accel "
@@ -1607,7 +1609,8 @@ def compile_scene(api) -> CompiledScene:
             from tpu_pbrt.accel.stream import STREAM_LEAF_TRIS
 
             leaf_tris = int(
-                _os.environ.get("TPU_PBRT_LEAF_TRIS", STREAM_LEAF_TRIS)
+                cfg.leaf_tris if cfg.leaf_tris is not None
+                else STREAM_LEAF_TRIS
             )
             dev["tstream"] = build_treelet_pack(
                 verts, bvh, leaf_tris=leaf_tris, tri_verts1=verts1

@@ -18,7 +18,6 @@ progressreporter.{h,cpp} (SURVEY.md §5.1/§5.5):
 
 from __future__ import annotations
 
-import os
 import sys
 import time
 from collections import defaultdict
@@ -143,8 +142,12 @@ class ProgressReporter:
         self.title = title
         self.done_work = 0
         self.start = time.time()
-        freq = os.environ.get("PBRT_PROGRESS_FREQUENCY")
-        self.min_interval = float(freq) if freq else 0.25
+        from tpu_pbrt.config import cfg
+
+        freq = cfg.progress_frequency
+        # `is not None`, not truthiness: PBRT_PROGRESS_FREQUENCY=0 means
+        # print on every update (pbrt's continuous mode)
+        self.min_interval = float(freq) if freq is not None else 0.25
         self.quiet = quiet
         self._last_print = 0.0
         self._printed_len = 0
