@@ -67,6 +67,42 @@ def probe_backend(timeout_s: float = 150.0) -> tuple[bool, str]:
             time.sleep(60)
     return False, detail
 
+def static_wave_cost(res: int, spp: int, timeout_s: float = 150.0) -> dict:
+    """Static per-wave roofline of the production-shaped pool drain
+    (tpu_pbrt/analysis/cost.py --bench-wave), computed in a CPU
+    SUBPROCESS: a pure jaxpr trace that needs NO accelerator — which is
+    the point (ISSUE 3): the r5 capture was an infra outage and its
+    BENCH JSON carried zero perf signal; these fields keep the static
+    half of the signal alive through any outage. Returns {} on failure
+    (the judged metrics must never depend on this)."""
+    if os.environ.get("BENCH_SKIP_STATIC"):
+        return {}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("BENCH_SKIP_STATIC", None)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "tpu_pbrt.analysis.cost",
+             "--bench-wave", "--res", str(res), "--spp", str(spp)],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+        if r.returncode == 0 and r.stdout.strip():
+            d = json.loads(r.stdout.strip().splitlines()[-1])
+            return {
+                k: d[k]
+                for k in ("static_flops_per_wave", "static_bytes_per_wave",
+                          "static_intensity")
+                if k in d
+            }
+        print(
+            f"static wave cost subprocess rc={r.returncode}: "
+            f"{(r.stderr or '').strip().splitlines()[-1:] or ['?']}",
+            file=sys.stderr,
+        )
+    except Exception as e:  # noqa: BLE001 — advisory fields only
+        print(f"static wave cost failed: {e}", file=sys.stderr)
+    return {}
+
+
 #: last completed throughput measurement, reported by the SIGTERM/exception
 #: fallback so a mid-phase kill still lands the number we already have
 _last_line = None
@@ -141,14 +177,21 @@ def main():
     if not os.environ.get("BENCH_SKIP_PROBE"):
         ok, detail = probe_backend()
         if not ok:
-            print(json.dumps({
+            line = {
                 "metric": "killeroo_like_path_mray_per_sec",
                 "value": 0.0, "unit": "Mray/s", "vs_baseline": 0.0,
                 "infra_outage": True,
                 "error": f"accelerator backend unreachable ({detail}); "
                          "perf not measurable this capture — see "
                          "BASELINE.md for the last committed measurement",
-            }))
+            }
+            # the static half of the perf signal survives the outage:
+            # per-wave roofline from a CPU-side jaxpr trace (ISSUE 3)
+            if remaining() > 60:
+                line.update(static_wave_cost(
+                    res, spp, timeout_s=max(min(remaining() - 20, 150), 30)
+                ))
+            print(json.dumps(line))
             return
         print(f"backend: {detail}", file=sys.stderr)
 
@@ -279,6 +322,15 @@ def main():
                 )
         except Exception as e:  # noqa: BLE001 — MSE failure must not eat the perf number
             print(f"mse computation failed: {e}", file=sys.stderr)
+
+    # static per-wave roofline next to the measured occupancy (ISSUE 3):
+    # the same fields the outage path emits, so BENCH rows stay
+    # comparable across infra-up and infra-down captures. Runs LAST —
+    # it is advisory and must never starve the judged crown/MSE legs.
+    if remaining() > 45:
+        _last_line.update(static_wave_cost(
+            res, spp, timeout_s=max(min(remaining() - 15, 150), 30)
+        ))
 
     line = dict(_last_line)
     if mse is not None:

@@ -1,12 +1,19 @@
 #!/usr/bin/env bash
-# Local CI gate (ISSUE 2 satellite): ruff -> jaxlint -> tier-1 pytest.
+# Local CI gate (ISSUE 2 + ISSUE 3 satellites):
+#   ruff -> jaxlint (AST) -> jaxpr audit + jaxcost budget gate + shardcheck
+#   -> tier-1 pytest.
 #
 #   tools/ci.sh            # full gate
-#   tools/ci.sh --fast     # skip the pytest leg (lint + audit only)
+#   tools/ci.sh --fast     # skip the pytest leg (lint + audit + gates only)
 #
 # ruff is optional in minimal containers (the image does not bake it);
 # the repo-specific invariants are enforced by `python -m
-# tpu_pbrt.analysis` regardless.
+# tpu_pbrt.analysis` regardless. The jaxcost budget gate compares the
+# entry-point static rooflines against the committed
+# tpu_pbrt/analysis/budgets.json — a perf regression fails HERE even
+# when no accelerator is reachable (the BENCH_r05 outage class); after
+# an INTENTIONAL hot-path change refresh with
+# `python -m tpu_pbrt.analysis --update-budgets` and commit the file.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -21,7 +28,13 @@ else
     echo "   ruff not installed — skipping (pip install ruff to enable)"
 fi
 
-echo "== jaxlint (python -m tpu_pbrt.analysis)"
+# fail-FAST stage: the AST lint costs ~2 s with no jax import; a lint
+# error aborts here before the multi-minute trace/compile stages below
+# (which re-lint — the duplication is the price of the early exit)
+echo "== jaxlint AST layer (python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck)"
+python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck
+
+echo "== jaxpr audit + jaxcost budget gate + shardcheck (python -m tpu_pbrt.analysis)"
 python -m tpu_pbrt.analysis
 
 if [[ "${1:-}" == "--fast" ]]; then

@@ -630,7 +630,7 @@ def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool,
 
 
 def _finalize_hits(tri_verts, o, d, t_raw, prim, time=None,
-                   tri_verts1=None) -> Hit:
+                   tri_verts1=None, tv9T=None, tv9T1=None) -> Hit:
     """(t, prim) -> full Hit: ONE tri_verts row fetch per ray recovers
     the winner's barycentrics (beats scattering b0/b1 per tested block
     slot during the merge), and the fetched vertices ride along in
@@ -640,14 +640,21 @@ def _finalize_hits(tri_verts, o, d, t_raw, prim, time=None,
     t = jnp.where(hit, t_raw, jnp.inf)
     # take from a lane-major (9, T) view: the native (T, 3, 3) layout
     # gathers at ~33 ns per fetched element on this v5e, a lane-major
-    # axis-1 take at ~2.6 (the reshape+transpose copies once per wave)
+    # axis-1 take at ~2.6. The scene compiler bakes the (9, T) table
+    # once (dev["tri_verts9T"]) — recomputing it here cost a
+    # whole-triangle-table relayout copy EVERY wave
+    # (JC-CHURN's sibling finding JC-RELAYOUT:stream_intersect:
+    # "transpose of (T, 9) buffer"); the fallback below keeps direct
+    # callers (tests, tools) working without a compiled scene.
     T = tri_verts.shape[0]
-    tv9T = tri_verts.reshape(T, 9).T  # (9, T)
+    if tv9T is None:
+        tv9T = tri_verts.reshape(T, 9).T  # (9, T)
     tv = jnp.take(tv9T, jnp.maximum(prim, 0), axis=1).T.reshape(
         -1, 3, 3
     )  # (R, 3, 3)
     if tri_verts1 is not None and time is not None:
-        tv9T1 = tri_verts1.reshape(T, 9).T
+        if tv9T1 is None:
+            tv9T1 = tri_verts1.reshape(T, 9).T
         tv1 = jnp.take(tv9T1, jnp.maximum(prim, 0), axis=1).T.reshape(-1, 3, 3)
         tm = jnp.asarray(time, jnp.float32).reshape(-1, 1, 1)
         tv = (1.0 - tm) * tv + tm * tv1
@@ -668,22 +675,27 @@ def _finalize_hits(tri_verts, o, d, t_raw, prim, time=None,
 
 @jax.jit
 def stream_intersect(tp: TreeletPack, tri_verts, o, d, t_max,
-                     time=None, tri_verts1=None) -> Hit:
+                     time=None, tri_verts1=None, tv9T=None,
+                     tv9T1=None) -> Hit:
     """Closest hit for a flat ray batch. o, d: (R, 3); t_max scalar or
     (R,). Returns Hit with global leaf-order triangle ids (and the hit
     vertices in Hit.tv) — API-compatible with bvh_intersect /
     wide_intersect / packet_intersect. time/tri_verts1: motion blur
-    (see _traverse/_finalize_hits)."""
+    (see _traverse/_finalize_hits). tv9T/tv9T1: the compile-time
+    lane-major (9, T) vertex tables (dev["tri_verts9T"]); omitted, the
+    relayout is recomputed per wave."""
     t_max = jnp.broadcast_to(jnp.asarray(t_max, jnp.float32), o.shape[:-1])
     s = _traverse(tp, o, d, t_max, False, time=time)
     return _finalize_hits(
-        tri_verts, o, d, s.rayF[6], s.prim, time=time, tri_verts1=tri_verts1
+        tri_verts, o, d, s.rayF[6], s.prim, time=time,
+        tri_verts1=tri_verts1, tv9T=tv9T, tv9T1=tv9T1,
     )
 
 
 @partial(jax.jit, static_argnames=("n_finalize",))
 def stream_intersect_split(tp: TreeletPack, tri_verts, o, d, t_max,
-                           n_finalize: int, time=None, tri_verts1=None):
+                           n_finalize: int, time=None, tri_verts1=None,
+                           tv9T=None, tv9T1=None):
     """Fused-wave closest hit: traverse ALL rays, but build the full Hit
     (barycentric refetch) only for the first n_finalize — the tail (the
     integrator's queued shadow rays) needs just prim>=0, and skipping
@@ -694,7 +706,7 @@ def stream_intersect_split(tp: TreeletPack, tri_verts, o, d, t_max,
     hit = _finalize_hits(
         tri_verts, o[:n], d[:n], s.rayF[6][:n], s.prim[:n],
         time=None if time is None else time[:n],
-        tri_verts1=tri_verts1,
+        tri_verts1=tri_verts1, tv9T=tv9T, tv9T1=tv9T1,
     )
     return hit, s.prim[n:]
 

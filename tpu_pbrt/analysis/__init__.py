@@ -1,6 +1,6 @@
 """jaxlint — repo-specific static analysis + jaxpr audit for TPU hot paths.
 
-Two layers (ISSUE 2):
+Four layers (ISSUE 2 + ISSUE 3):
 
 - **Layer 1 (AST lint, `lint.py`)**: syntactic rules over the source tree.
   A per-module call graph seeded at `jax.jit` / `lax.while_loop` /
@@ -17,8 +17,24 @@ Two layers (ISSUE 2):
   buffers, zero retraces across same-shape waves, and a clean smoke
   render under jax.transfer_guard("disallow").
 
+- **Layer 3 (static roofline budgets, `cost.py`)**: an abstract
+  interpreter charges every entry-point equation FLOPs and HBM bytes,
+  rolls them up per wave, gates against the committed `budgets.json`
+  (refresh: `--update-budgets`), and reports anti-pattern findings
+  (dtype churn, hot-buffer relayouts, narrow unsorted gathers,
+  broadcast blowups, tile-padding waste) — a perf regression signal
+  that works with the TPU tunnel down (the BENCH_r05 outage).
+
+- **Layer 4 (shard_map replication analysis, `shardcheck.py`)**: tracks
+  replicated-vs-varying values through every shard_map body and errors
+  when an output claimed replicated (out_spec P()) was never reduced
+  over the mesh axis, or a collective sits inside a varying-trip-count
+  loop — restoring (and exceeding) the native check_rep/check_vma that
+  SHARD_MAP_NOCHECK disables on jax versions where it is broken.
+
 Run `python -m tpu_pbrt.analysis` (see `__main__.py`), or the pytest
-mirrors in tests/test_jaxlint.py and tests/test_jaxpr_audit.py.
+mirrors in tests/test_jaxlint.py, test_jaxpr_audit.py, test_cost.py and
+test_shardcheck.py.
 """
 
 from tpu_pbrt.analysis.lint import (  # noqa: F401

@@ -1,8 +1,16 @@
-"""`python -m tpu_pbrt.analysis` — run the jaxlint suite.
+"""`python -m tpu_pbrt.analysis` — run the full analysis suite.
 
-Layer 1 (AST lint) always runs; layer 2 (jaxpr/compile audit) runs unless
---no-audit (it compiles small render programs, a few seconds on CPU).
-Exit code 0 iff no error-severity findings.
+Stages (each skippable):
+- layer 1, AST lint (`lint.py`) — always runs;
+- layer 2, jaxpr/compile audit (`audit.py`) — `--no-audit` skips (it
+  compiles small render programs, a few seconds on CPU);
+- jaxcost static roofline + budget gate (`cost.py`) — `--no-cost`
+  skips; `--update-budgets` refreshes the committed
+  `tpu_pbrt/analysis/budgets.json` instead of gating against it;
+- shardcheck replication analysis (`shardcheck.py`) —
+  `--no-shardcheck` skips.
+
+Exit code 0 iff no error-severity findings in any stage that ran.
 """
 
 from __future__ import annotations
@@ -11,6 +19,31 @@ import argparse
 import json
 import sys
 from pathlib import Path
+
+
+def _setup_jax_env() -> None:
+    """One-time jax process setup shared by every jaxpr-tracing stage.
+    Must happen before jax initializes a backend."""
+    import os
+
+    # only when the operator EXPLICITLY selected cpu (tools/ci.sh
+    # does): unset JAX_PLATFORMS on a TPU VM means a TPU backend,
+    # which must not inherit the unoptimized-CPU pipeline flag
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_backend_optimization_level" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_backend_optimization_level=0"
+            ).strip()
+    import jax
+
+    repo_root = Path(__file__).resolve().parents[2]
+    cache = repo_root / ".jax_cache"
+    if cache.is_dir():
+        jax.config.update("jax_compilation_cache_dir", str(cache))
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 1.0
+        )
 
 
 def main(argv=None) -> int:
@@ -22,6 +55,19 @@ def main(argv=None) -> int:
         "--no-audit", action="store_true",
         help="skip the jaxpr/compile-time audit layer",
     )
+    ap.add_argument(
+        "--no-cost", action="store_true",
+        help="skip the jaxcost roofline/budget stage",
+    )
+    ap.add_argument(
+        "--no-shardcheck", action="store_true",
+        help="skip the shard_map replication analysis",
+    )
+    ap.add_argument(
+        "--update-budgets", action="store_true",
+        help="refresh tpu_pbrt/analysis/budgets.json from the current "
+             "tree instead of gating against it (commit the result)",
+    )
     ap.add_argument("--format", choices=("text", "json"), default="text")
     args = ap.parse_args(argv)
 
@@ -32,43 +78,68 @@ def main(argv=None) -> int:
     violations, pragmas = lint_tree(repo_root, paths)
     over_budget = paths is None and pragmas > PRAGMA_BUDGET
 
+    need_jax = not (args.no_audit and args.no_cost and args.no_shardcheck)
+    if need_jax:
+        # CPU audit/cost/shardcheck compile or trace tiny programs; the
+        # unoptimized XLA pipeline + the repo compilation cache keep
+        # this to seconds.
+        _setup_jax_env()
+
     audit_failures = []
     if not args.no_audit:
-        # CPU audit runs compile tiny programs; the unoptimized XLA
-        # pipeline + the repo compilation cache keep this to seconds.
-        # Must happen before jax initializes a backend.
-        import os
-
-        # only when the operator EXPLICITLY selected cpu (tools/ci.sh
-        # does): unset JAX_PLATFORMS on a TPU VM means a TPU backend,
-        # which must not inherit the unoptimized-CPU pipeline flag
-        if os.environ.get("JAX_PLATFORMS") == "cpu":
-            flags = os.environ.get("XLA_FLAGS", "")
-            if "xla_backend_optimization_level" not in flags:
-                os.environ["XLA_FLAGS"] = (
-                    flags + " --xla_backend_optimization_level=0"
-                ).strip()
-        import jax
-
-        cache = repo_root / ".jax_cache"
-        if cache.is_dir():
-            jax.config.update("jax_compilation_cache_dir", str(cache))
-            jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 1.0
-            )
-
         from tpu_pbrt.analysis.audit import run_audit
 
         audit_failures = run_audit()
 
+    cost_errors: list = []
+    cost_warnings: list = []
+    rollups = {}
+    cost_findings: list = []
+    if not args.no_cost:
+        from tpu_pbrt.analysis.cost import run_cost
+
+        cost_errors, cost_warnings, rollups, cost_findings = run_cost(
+            update=args.update_budgets
+        )
+
+    shard_errors: list = []
+    shard_warnings: list = []
+    if not args.no_shardcheck:
+        from tpu_pbrt.analysis.shardcheck import run_shardcheck
+
+        shard_errors, shard_warnings = run_shardcheck()
+
     errors = [v for v in violations if v.severity == "error"]
-    ok = not errors and not audit_failures and not over_budget
+    ok = not (
+        errors or audit_failures or over_budget or cost_errors
+        or shard_errors
+    )
     if args.format == "json":
         print(
             json.dumps(
                 {
                     "lint": [v.__dict__ for v in violations],
                     "audit": audit_failures,
+                    "cost": {
+                        "rollups": {
+                            k: r.to_json() for k, r in rollups.items()
+                        },
+                        "findings": [
+                            {
+                                "rule": f.rule, "entry": f.entry,
+                                "detail": f.detail,
+                                "severity": f.severity,
+                                "waived": f.waived,
+                            }
+                            for f in cost_findings
+                        ],
+                        "errors": cost_errors,
+                        "warnings": cost_warnings,
+                    },
+                    "shardcheck": {
+                        "errors": shard_errors,
+                        "warnings": shard_warnings,
+                    },
                     "pragmas": pragmas,
                     "pragma_budget": PRAGMA_BUDGET,
                     "ok": ok,
@@ -80,10 +151,35 @@ def main(argv=None) -> int:
             print(v)
         for f in audit_failures:
             print(f"AUDIT: {f}")
+        for w in cost_warnings:
+            print(f"COST [warning]: {w}")
+        for e in cost_errors:
+            print(f"COST [error]: {e}")
+        for w in shard_warnings:
+            print(f"SHARDCHECK [warning]: {w}")
+        for e in shard_errors:
+            print(f"SHARDCHECK [error]: {e}")
+        if args.update_budgets and not args.no_cost:
+            from tpu_pbrt.analysis.cost import BUDGETS_PATH
+
+            print(f"jaxcost: budgets refreshed -> {BUDGETS_PATH}")
         n_warn = len(violations) - len(errors)
+        # a SKIPPED stage must not read as a clean one in the summary
+        audit_part = (
+            "audit skipped" if args.no_audit
+            else f"{len(audit_failures)} audit failure(s)"
+        )
+        cost_part = (
+            "cost skipped" if args.no_cost
+            else f"{len(cost_errors)} cost error(s)"
+        )
+        shard_part = (
+            "shardcheck skipped" if args.no_shardcheck
+            else f"{len(shard_errors)} shardcheck error(s)"
+        )
         print(
             f"jaxlint: {len(errors)} error(s), {n_warn} warning(s), "
-            f"{len(audit_failures)} audit failure(s), "
+            f"{audit_part}, {cost_part}, {shard_part}, "
             f"{pragmas} pragma suppression(s) (budget {PRAGMA_BUDGET})"
         )
         if over_budget:

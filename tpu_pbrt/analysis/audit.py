@@ -218,7 +218,8 @@ def stream_traversal_jaxpr():
     d = jnp.tile(jnp.asarray([0.0, 0.0, 1.0], jnp.float32), (n, 1))
     return jax.make_jaxpr(
         lambda o, d: stream_intersect(
-            dev["tstream"], dev["tri_verts"], o, d, jnp.inf
+            dev["tstream"], dev["tri_verts"], o, d, jnp.inf,
+            tv9T=dev.get("tri_verts9T"),
         )
     )(o, d)
 

@@ -50,6 +50,20 @@ def _float(name: str, default: Optional[float]) -> Optional[float]:
     return default if v in (None, "") else float(v)
 
 
+def _triflag(name: str) -> Optional[bool]:
+    """Three-state knob: explicit truthy/falsy forces the value, unset or
+    unrecognized means 'auto' (None) — the caller picks the default."""
+    v = os.environ.get(name)
+    if v is None:
+        return None
+    v = v.strip().lower()
+    if v in _FALSY:
+        return False
+    if v in _TRUTHY:
+        return True
+    return None
+
+
 class Config:
     """Snapshot of every environment knob. Attributes only — no methods
     touch os.environ after _load()."""
@@ -71,6 +85,7 @@ class Config:
         "pool",
         "audit_drops",
         "allow_drops",
+        "shard_native_check",
     )
 
     def _load(self) -> "Config":
@@ -112,6 +127,12 @@ class Config:
         self.audit_drops: bool = _flag("TPU_PBRT_AUDIT_DROPS", True)
         #: downgrade a detected capacity overflow to a warning
         self.allow_drops: bool = _flag("TPU_PBRT_ALLOW_DROPS", False)
+        #: force jax's native shard_map replication check on (True) or
+        #: off (False); None = auto by jax version (parallel/mesh.py
+        #: resolve_shard_map_nocheck)
+        self.shard_native_check: Optional[bool] = _triflag(
+            "TPU_PBRT_SHARD_NATIVE_CHECK"
+        )
         return self
 
 

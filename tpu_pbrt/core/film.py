@@ -134,11 +134,18 @@ class Film:
         if ray_weight is not None:
             L = L * jnp.asarray(ray_weight, jnp.float32)[..., None]
 
-        # discrete coords: pixel (x,y) has its sample center at x+0.5
+        # discrete coords: pixel (x,y) has its sample center at x+0.5.
+        # x0f/y0f stay f32 next to their int32 twins: ceil() is exact on
+        # integer-valued f32, so feeding the filter from the float copy
+        # is bit-identical to re-converting the ints — and deletes the
+        # f32->i32->f32 round trip the cost pass flagged
+        # (JC-CHURN:film.add_samples: two convert passes per footprint tap)
         dx = p_film[..., 0] - 0.5
         dy = p_film[..., 1] - 0.5
-        x0 = jnp.ceil(dx - f.xwidth).astype(jnp.int32)
-        y0 = jnp.ceil(dy - f.ywidth).astype(jnp.int32)
+        x0f = jnp.ceil(dx - f.xwidth)
+        y0f = jnp.ceil(dy - f.ywidth)
+        x0 = x0f.astype(jnp.int32)
+        y0 = y0f.astype(jnp.int32)
         nx = int(math.floor(2 * f.xwidth)) + 1
         ny = int(math.floor(2 * f.ywidth)) + 1
         rx, ryres = self.full_resolution
@@ -149,7 +156,7 @@ class Film:
             for ox in range(nx):
                 px = x0 + ox
                 py = y0 + oy
-                fw = f.evaluate(px.astype(jnp.float32) - dx, py.astype(jnp.float32) - dy)
+                fw = f.evaluate((x0f + ox) - dx, (y0f + oy) - dy)
                 inb = (px >= cx0) & (px < cx1) & (py >= cy0) & (py < cy1)
                 fw = jnp.where(inb, fw, 0.0)
                 pxc = jnp.clip(px, 0, rx - 1)

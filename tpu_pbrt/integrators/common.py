@@ -80,6 +80,7 @@ def scene_intersect(dev, o, d, t_max, time=None) -> Hit:
         return stream_intersect(
             dev["tstream"], dev["tri_verts"], o, d, t_max,
             time=time, tri_verts1=dev.get("tri_verts1"),
+            tv9T=dev.get("tri_verts9T"), tv9T1=dev.get("tri_verts1_9T"),
         )
     if "tpack" in dev:
         from tpu_pbrt.accel.packet import packet_intersect
@@ -117,6 +118,7 @@ def scene_intersect_fused(dev, o, d, t_max, n_cam: int, time=None):
         return stream_intersect_split(
             dev["tstream"], dev["tri_verts"], o, d, t_max, n_cam,
             time=time, tri_verts1=dev.get("tri_verts1"),
+            tv9T=dev.get("tri_verts9T"), tv9T1=dev.get("tri_verts1_9T"),
         )
     hit = scene_intersect(dev, o, d, t_max, time=time)
     return jax.tree.map(lambda a: a[:n_cam], hit), hit.prim[n_cam:]

@@ -34,18 +34,58 @@ except ImportError:  # pragma: no cover
 
 import inspect
 
-#: kwargs disabling shard_map's replication/varying-manual-axes check —
-#: the BVH while_loop carries start replicated and become varying over
-#: the tile axis, so the check must be off rather than pcast-ing every
-#: loop carry. The kwarg is `check_vma` in jax >= 0.9 and `check_rep`
-#: before; resolve it once against the running version.
-SHARD_MAP_NOCHECK = {
-    (
+
+def _jax_version() -> tuple:
+    """(major, minor, patch) of the running jax, zeros on parse failure
+    (dev builds) so the conservative branch wins."""
+    parts = []
+    for tok in jax.__version__.split(".")[:3]:
+        digits = "".join(c for c in tok if c.isdigit())
+        parts.append(int(digits) if digits else 0)
+    while len(parts) < 3:
+        parts.append(0)
+    return tuple(parts)
+
+
+def resolve_shard_map_nocheck() -> dict:
+    """kwargs for shard_map's replication/varying-manual-axes check,
+    gated on jax version (ISSUE 3 satellite).
+
+    On jax 0.4.x-0.6.x the native `check_rep` rejects our programs: the
+    BVH/drain while_loops carry values that start replicated and become
+    varying over the tile axis, and pre-0.7 check_rep has no pvary
+    plumbing for loop carries — PR 1 measured three test_distributed
+    failures from it, so those versions get `check_rep=False`. From the
+    0.7 varying-manual-axes rework on, the native check is EXPECTED to
+    understand loop-carry transitions; keep it enabled there so jax
+    cross-validates what analysis/shardcheck.py verifies statically (two
+    independent checkers watching the same invariant). That expectation
+    is untestable on the pinned container jax (0.4.37) — if a given
+    0.7+ release still rejects our carries (e.g. demands explicit
+    jax.lax.pvary), every mesh render fails at trace time with jax's
+    own diagnostic: set TPU_PBRT_SHARD_NATIVE_CHECK=0 and file the
+    version here. The kwarg is `check_vma` in new jax and `check_rep`
+    before; resolve against the live signature.
+
+    TPU_PBRT_SHARD_NATIVE_CHECK=1/0 overrides the version gate both ways
+    (escape hatch for a jax release where the auto choice is wrong)."""
+    from tpu_pbrt.config import cfg
+
+    kwarg = (
         "check_vma"
         if "check_vma" in inspect.signature(shard_map).parameters
         else "check_rep"
-    ): False
-}
+    )
+    native_ok = cfg.shard_native_check
+    if native_ok is None:
+        native_ok = _jax_version() >= (0, 7, 0)
+    return {} if native_ok else {kwarg: False}
+
+
+#: resolved once at import (config snapshot contract); empty on versions
+#: where jax's own check is trusted, `{check_rep/check_vma: False}` where
+#: it is known-broken for our loop-carry programs
+SHARD_MAP_NOCHECK = resolve_shard_map_nocheck()
 
 TILE_AXIS = "tiles"
 

@@ -1615,6 +1615,15 @@ def compile_scene(api) -> CompiledScene:
             dev["tstream"] = build_treelet_pack(
                 verts, bvh, leaf_tris=leaf_tris, tri_verts1=verts1
             )
+            # lane-major (9, T) vertex table for _finalize_hits' winner
+            # refetch, baked ONCE here: recomputing reshape(T, 9).T
+            # inside the wave relayout-copied the whole triangle table
+            # per dispatch (cost-pass finding
+            # JC-RELAYOUT:stream_intersect:"transpose of (T, 9) buffer")
+            T9 = dev["tri_verts"].shape[0]
+            dev["tri_verts9T"] = dev["tri_verts"].reshape(T9, 9).T
+            if verts1 is not None:
+                dev["tri_verts1_9T"] = dev["tri_verts1"].reshape(T9, 9).T
     if has_envmap:
         dev["envmap"] = jnp.asarray(envmap, jnp.float32)
         dev["env_distr"] = env_distr
