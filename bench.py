@@ -24,6 +24,15 @@ A driver timeout can therefore never yield `parsed: null`.
 Env knobs: BENCH_SPP/BENCH_RES (throughput run), BENCH_BUDGET_S (total
 wall-clock budget, default 420), MSE_RES/MSE_SPP/REF_SPP (accuracy run),
 BENCH_SKIP_MSE=1 to skip the accuracy half.
+
+Telemetry (ISSUE 4): every phase heartbeats into the flight recorder
+(TPU_PBRT_FLIGHT_PATH, default BENCH_flight.jsonl) so an outage capture
+carries its phase timeline, probe retry/wait accounting and the last
+counter snapshot; `--trace out.json` (or TPU_PBRT_TRACE_PATH) exports a
+Chrome-trace/Perfetto span timeline; the measured JSON line gains a
+`telemetry` block — device counters, per-device wave-count spread, and
+the live-vs-static roofline ratio (obs/rooflive.py) next to the static
+fields.
 """
 
 import json
@@ -37,35 +46,76 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 T_START = time.time()
 BUDGET = float(os.environ.get("BENCH_BUDGET_S", "520"))
 
+# -- import-free flight heartbeats for the probe/outage phases -------------
+# The probe exists because an in-process accelerator-runtime import can
+# hang unboundedly; importing tpu_pbrt (whose package __init__ pulls jax)
+# before the probe succeeds would reintroduce exactly that hang. These
+# few lines mirror tpu_pbrt/obs/flight.py's JSONL format with ZERO
+# tpu_pbrt/jax imports; once the probe passes, the real FlightRecorder
+# takes over appending to the same file.
+_FLIGHT_PATH = os.environ.get("TPU_PBRT_FLIGHT_PATH") or "BENCH_flight.jsonl"
+_TELEMETRY_ON = os.environ.get("TPU_PBRT_TELEMETRY", "1").strip().lower() \
+    not in ("0", "false", "no", "off")
+_last_phase = None
 
-def probe_backend(timeout_s: float = 150.0) -> tuple[bool, str]:
+
+def _flight_heartbeat(phase: str, **fields):
+    global _last_phase
+    _last_phase = phase
+    if not _TELEMETRY_ON:
+        return
+    line = {"t": round(time.time(), 3),
+            "elapsed_s": round(time.time() - T_START, 3), "phase": phase}
+    line.update(fields)
+    try:
+        with open(_FLIGHT_PATH, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    except OSError:
+        pass
+
+
+def probe_backend(timeout_s: float = 150.0) -> tuple[bool, str, int, float]:
     """Bounded accelerator-backend health check in a SUBPROCESS (an
     in-process jax.devices() can hang indefinitely when the TPU tunnel
     is down — the r4 capture outage — and nothing in-process can bound
-    it). Returns (ok, detail). One retry after a cooldown: transient
-    tunnel resets recover; a real outage is then classified distinctly
-    so the judged line says 'infra outage', not 'tracer broke'."""
+    it; this function must therefore import NOTHING that imports jax).
+    Returns (ok, detail, retries, wait_seconds): retries = probe
+    attempts beyond the first, wait_seconds = total time burned in the
+    probe incl. cooldowns — BENCH_r05 lost exactly this context (the
+    60 s retry loop only printed to stderr). One retry after a cooldown:
+    transient tunnel resets recover; a real outage is then classified
+    distinctly so the judged line says 'infra outage', not 'tracer
+    broke'."""
     code = (
         "import jax; d = jax.devices(); "
         "print(d[0].platform, len(d), flush=True)"
     )
+    t_probe = time.time()
+    retries = 0
     for attempt in (1, 2):
+        if attempt > 1:
+            retries += 1
+        _flight_heartbeat("probe", attempt=attempt)
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code],
                 capture_output=True, text=True, timeout=timeout_s,
             )
             if r.returncode == 0 and r.stdout.strip():
-                return True, r.stdout.strip()
+                detail = r.stdout.strip()
+                _flight_heartbeat("probe", attempt=attempt, ok=True,
+                                  backend=detail)
+                return True, detail, retries, time.time() - t_probe
             detail = (r.stderr or "").strip().splitlines()[-1:] or ["?"]
             detail = f"rc={r.returncode}: {detail[0][:200]}"
         except subprocess.TimeoutExpired:
             detail = f"backend init hung >{timeout_s:.0f}s"
+        _flight_heartbeat("probe", attempt=attempt, ok=False, detail=detail)
         if attempt == 1 and BUDGET - (time.time() - T_START) > timeout_s + 90:
             print(f"backend probe failed ({detail}); retrying in 60s",
                   file=sys.stderr)
             time.sleep(60)
-    return False, detail
+    return False, detail, retries, time.time() - t_probe
 
 def static_wave_cost(res: int, spp: int, timeout_s: float = 150.0) -> dict:
     """Static per-wave roofline of the production-shaped pool drain
@@ -167,15 +217,27 @@ def compute_mse(mse_res: int, mse_spp: int, ref_spp: int):
 
 
 def main():
+    # --trace out.json exports the span timeline; unknown args are left
+    # for the driver (bench is also run bare by scripts that predate it)
+    import argparse
+
+    ap = argparse.ArgumentParser(add_help=False)
+    ap.add_argument("--trace", default="")
+    args, _ = ap.parse_known_args()
+
     # judged work shape (BASELINE.json: killeroo/crown @ 256spp)
     spp = int(os.environ.get("BENCH_SPP", "256"))
     res = int(os.environ.get("BENCH_RES", "512"))
 
     # classify an accelerator outage BEFORE touching jax in-process
     # (VERDICT r4 weak #1: the r4 capture recorded 0.0 Mray/s because
-    # the 'axon' backend was down — an infra condition, not a perf one)
+    # the 'axon' backend was down — an infra condition, not a perf one).
+    # NOTHING on this path may import tpu_pbrt/jax: if the accelerator
+    # runtime is what's hanging, an in-process import would stall the
+    # capture before the bounded probe ever runs. Heartbeats use the
+    # import-free writer; the static fields come from a subprocess.
     if not os.environ.get("BENCH_SKIP_PROBE"):
-        ok, detail = probe_backend()
+        ok, detail, retries, wait_s = probe_backend()
         if not ok:
             line = {
                 "metric": "killeroo_like_path_mray_per_sec",
@@ -184,6 +246,12 @@ def main():
                 "error": f"accelerator backend unreachable ({detail}); "
                          "perf not measurable this capture — see "
                          "BASELINE.md for the last committed measurement",
+                # the probe's own accounting + where the flight recorder
+                # last heartbeat — the diagnosis BENCH_r05 lacked
+                "probe_retries": retries,
+                "probe_wait_seconds": round(wait_s, 1),
+                "flight_phase": _last_phase,
+                "flight_path": _FLIGHT_PATH,
             }
             # the static half of the perf signal survives the outage:
             # per-wave roofline from a CPU-side jaxpr trace (ISSUE 3)
@@ -191,27 +259,57 @@ def main():
                 line.update(static_wave_cost(
                     res, spp, timeout_s=max(min(remaining() - 20, 150), 30)
                 ))
+            # the telemetry block exists even through an outage so rows
+            # stay schema-comparable; the live half is null by
+            # definition (inline literal — obs.rooflive would import
+            # tpu_pbrt, see above)
+            line["telemetry"] = {
+                "counters": None, "wave_spread": None,
+                "live_bytes_per_sec": None, "live_flops_per_sec": None,
+                "hbm_peak_bytes_per_sec": None,
+                "live_vs_static_ratio": None,
+            }
+            _flight_heartbeat("report", infra_outage=True, retries=retries)
             print(json.dumps(line))
             return
         print(f"backend: {detail}", file=sys.stderr)
 
+    # backend reachable: from here on tpu_pbrt (and jax) are safe to
+    # import — hand the flight file over to the real recorder and arm
+    # the span recorder
+    from tpu_pbrt.obs.flight import FLIGHT
+    from tpu_pbrt.obs.trace import TRACE
+
+    FLIGHT.configure(_FLIGHT_PATH, t0=T_START)
+    if args.trace:
+        TRACE.configure(args.trace)
+
     from tpu_pbrt.scenes import compile_api, make_killeroo_like
 
     tracker = CompileTracker()
-    api = make_killeroo_like(res=res, spp=spp)
-    scene, integ = compile_api(api)
+    FLIGHT.heartbeat("scene_compile", res=res, spp=spp)
+    with TRACE.span("bench/scene_compile"):
+        api = make_killeroo_like(res=res, spp=spp)
+        scene, integ = compile_api(api)
 
     # Warmup: a tightly budgeted pass populates the jit cache (identical
     # shapes). Its result doubles as the fallback measurement if compile
     # ate the budget — a compile-tainted number still beats no number.
-    result = integ.render(scene, max_seconds=5)
+    FLIGHT.heartbeat("warmup")
+    with TRACE.span("bench/warmup"):
+        result = integ.render(scene, max_seconds=5)
     compiles_after_warmup = tracker.compiles
     if remaining() > 60:
         # steady-state throughput stabilizes well before completion; box
         # the main leg so the MSE and crown legs fit the total budget
-        result = integ.render(
-            scene, max_seconds=min(remaining() - 30.0, max(60.0, remaining() * 0.22))
-        )
+        FLIGHT.heartbeat("measure")
+        with TRACE.span("bench/measure"):
+            result = integ.render(
+                scene,
+                max_seconds=min(
+                    remaining() - 30.0, max(60.0, remaining() * 0.22)
+                ),
+            )
 
     # measured rays per camera ray from the run just completed (the class
     # default attribute is a lower bound; the real factor includes bounces
@@ -275,18 +373,20 @@ def main():
         try:
             from tpu_pbrt.scenes import make_crown_like
 
-            capi = make_crown_like(
-                res=int(os.environ.get("CROWN_RES", "512")),
-                spp=int(os.environ.get("CROWN_SPP", "256")),
-            )
-            cscene, cinteg = compile_api(capi)
-            cinteg.render(cscene, max_seconds=5)  # warmup (jit compile)
-            # the 1M-tri compile above is unbudgeted: re-check that the
-            # judged MSE leg still fits before spending more here
-            budget = remaining() - mse_reserve - 15.0
-            if budget < 10.0:
-                raise RuntimeError("crown skipped post-compile: budget")
-            cres = cinteg.render(cscene, max_seconds=budget)
+            FLIGHT.heartbeat("crown")
+            with TRACE.span("bench/crown"):
+                capi = make_crown_like(
+                    res=int(os.environ.get("CROWN_RES", "512")),
+                    spp=int(os.environ.get("CROWN_SPP", "256")),
+                )
+                cscene, cinteg = compile_api(capi)
+                cinteg.render(cscene, max_seconds=5)  # warmup (jit compile)
+                # the 1M-tri compile above is unbudgeted: re-check that
+                # the judged MSE leg still fits before spending more here
+                budget = remaining() - mse_reserve - 15.0
+                if budget < 10.0:
+                    raise RuntimeError("crown skipped post-compile: budget")
+                cres = cinteg.render(cscene, max_seconds=budget)
             import numpy as _np
 
             cmean = float(_np.mean(_np.asarray(cres.image, _np.float32)))
@@ -312,9 +412,12 @@ def main():
             est_s = est_rays / max(result.mray_per_sec, 1e-6) / 1e6 + 30.0
             budget = remaining() - 20.0
             if est_s < budget:
-                mse = compute_mse(
-                    mse_res, mse_spp, int(os.environ.get("REF_SPP", "256"))
-                )
+                FLIGHT.heartbeat("mse")
+                with TRACE.span("bench/mse"):
+                    mse = compute_mse(
+                        mse_res, mse_spp,
+                        int(os.environ.get("REF_SPP", "256")),
+                    )
             else:
                 print(
                     f"skipping MSE: est {est_s:.0f}s > budget {budget:.0f}s",
@@ -328,9 +431,36 @@ def main():
     # comparable across infra-up and infra-down captures. Runs LAST —
     # it is advisory and must never starve the judged crown/MSE legs.
     if remaining() > 45:
-        _last_line.update(static_wave_cost(
-            res, spp, timeout_s=max(min(remaining() - 15, 150), 30)
-        ))
+        with TRACE.span("bench/static_cost"):
+            _last_line.update(static_wave_cost(
+                res, spp, timeout_s=max(min(remaining() - 15, 150), 30)
+            ))
+
+    # telemetry block (ISSUE 4): device counters + per-device wave-count
+    # spread from the measured leg, and the live-vs-static roofline
+    # ratio closing the loop on the static fields above (null on CPU or
+    # when the static trace failed — the block is always present so
+    # BENCH rows stay schema-comparable)
+    import jax as _jax
+
+    from tpu_pbrt.obs.rooflive import live_vs_static
+
+    tstats = result.stats.get("telemetry") or {}
+    devs = _jax.devices()
+    _last_line["telemetry"] = {
+        "counters": tstats.get("counters"),
+        "wave_spread": tstats.get("wave_spread"),
+        **live_vs_static(
+            waves=result.stats.get("n_waves"),
+            seconds=result.seconds,
+            static_bytes_per_wave=_last_line.get("static_bytes_per_wave"),
+            static_flops_per_wave=_last_line.get("static_flops_per_wave"),
+            device_kind=getattr(devs[0], "device_kind", devs[0].platform),
+            n_devices=len(devs),
+        ),
+    }
+    if tstats.get("counters"):
+        FLIGHT.counters(tstats["counters"], phase="measure_counters")
 
     line = dict(_last_line)
     if mse is not None:
@@ -338,6 +468,8 @@ def main():
         line["mse_target"] = 1e-4
     if crown:
         line.update(crown)
+    FLIGHT.heartbeat("report", mray_per_sec=line.get("value"))
+    TRACE.maybe_export()
     print(json.dumps(line))
 
 
@@ -361,5 +493,21 @@ if __name__ == "__main__":
             "vs_baseline": 0.0,
         }
         line["error"] = f"{type(e).__name__}: {e}"
+        # the flight recorder's last phase turns "signal 15" into "died
+        # mid-<phase> after N s" for the post-mortem. Only touch the
+        # real recorder if tpu_pbrt ALREADY imported — a fatal during a
+        # hung-runtime capture must not start the import that hangs.
+        try:
+            mod = sys.modules.get("tpu_pbrt.obs.flight")
+            if mod is not None and mod.FLIGHT.last_phase is not None:
+                line["flight_phase"] = mod.FLIGHT.last_phase
+            else:
+                line["flight_phase"] = _last_phase
+            _flight_heartbeat("fatal", error=line["error"])
+            tmod = sys.modules.get("tpu_pbrt.obs.trace")
+            if tmod is not None:
+                tmod.TRACE.maybe_export()
+        except Exception:  # noqa: BLE001 — telemetry must not mask the error
+            pass
         print(json.dumps(line))
         sys.exit(0)

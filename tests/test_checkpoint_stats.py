@@ -15,8 +15,8 @@ class TestCheckpoint:
         st = scene.film.init_state()
         p = str(tmp_path / "ck.npz")
         save_checkpoint(p, st, 7, 1234)
-        st2, nxt, rays = load_checkpoint(p)
-        assert nxt == 7 and rays == 1234
+        st2, nxt, rays, ctr = load_checkpoint(p)
+        assert nxt == 7 and rays == 1234 and ctr == {}
         assert np.array_equal(np.asarray(st.rgb), np.asarray(st2.rgb))
 
     def test_resume_bit_identical(self, tmp_path):
@@ -40,7 +40,7 @@ class TestCheckpoint:
             api2 = make_cornell(res=16, spp=8, integrator="directlighting", maxdepth=2)
             scene2, integ2 = compile_api(api2)
             integ2.render(scene2, checkpoint_path=p, checkpoint_every=1)
-            st, nxt, rays = load_checkpoint(p)
+            st, nxt, rays, _ = load_checkpoint(p)
             # rewind the cursor to mid-render and resume
             save_checkpoint(p, scene2.film.init_state(), 0, 0)
             r3 = integ2.render(scene2, checkpoint_path=p, checkpoint_every=1)
@@ -97,8 +97,85 @@ class TestCheckpointFingerprint:
         p = str(tmp_path / "ck.npz")
         save_checkpoint(p, st, 3, 100, fingerprint="chunk=1024;spp=8")
         # same fingerprint resumes
-        _, nxt, rays = load_checkpoint(p, "chunk=1024;spp=8")
+        _, nxt, rays, _ = load_checkpoint(p, "chunk=1024;spp=8")
         assert (nxt, rays) == (3, 100)
         # different fingerprint is refused
         with pytest.raises(ValueError, match="different render configuration"):
             load_checkpoint(p, "chunk=2048;spp=8")
+
+
+class TestCheckpointCounters:
+    """ISSUE 4 satellite: the cumulative telemetry-counter snapshot is a
+    versioned checkpoint field, so a resumed render reports end-to-end
+    totals."""
+
+    def _tiny_state(self):
+        import jax.numpy as jnp
+
+        from tpu_pbrt.core.film import FilmState
+
+        return FilmState(
+            rgb=jnp.zeros((4, 4, 3)), weight=jnp.zeros((4, 4)),
+            splat=jnp.zeros((4, 4, 3)),
+        )
+
+    def test_counter_snapshot_roundtrip(self, tmp_path):
+        snap = {
+            "rays_traced": 4912, "lanes_regenerated": 1024,
+            "occupancy_histogram": [0, 1, 2, 3, 0, 0, 0, 4],
+        }
+        p = str(tmp_path / "ck.npz")
+        save_checkpoint(p, self._tiny_state(), 2, 99, counters=snap)
+        _, nxt, rays, ctr = load_checkpoint(p)
+        assert (nxt, rays) == (2, 99)
+        assert ctr == snap
+
+    def test_v2_checkpoint_loads_without_counters(self, tmp_path):
+        """A pre-telemetry (v2) file — no counters field — still resumes,
+        with an empty snapshot."""
+        st = self._tiny_state()
+        p = str(tmp_path / "old.npz")
+        np.savez_compressed(
+            p, version=2, rgb=np.asarray(st.rgb),
+            weight=np.asarray(st.weight), splat=np.asarray(st.splat),
+            next_chunk=5, rays=777, fingerprint=np.array(""),
+        )
+        st2, nxt, rays, ctr = load_checkpoint(p)
+        assert (nxt, rays, ctr) == (5, 777, {})
+
+    def test_resumed_render_reports_end_to_end_totals(self, tmp_path):
+        """Resume a FINISHED pool render from its checkpoint: zero new
+        chunks run, yet the reported telemetry counters are the full
+        render's totals (seeded from the snapshot)."""
+        import os
+
+        from tpu_pbrt.scenes import compile_api, make_cornell
+
+        os.environ["TPU_PBRT_CHUNK"] = "1024"  # force multiple chunks
+        from tpu_pbrt import config
+
+        config.reload()
+        try:
+            api = make_cornell(res=16, spp=8, integrator="path", maxdepth=2)
+            scene, integ = compile_api(api)
+            p = str(tmp_path / "pool.npz")
+            full = integ.render(scene, checkpoint_path=p, checkpoint_every=1)
+            totals = full.stats["telemetry"]["counters"]
+            assert totals["rays_traced"] == full.rays_traced > 0
+            resumed = integ.render(
+                scene, checkpoint_path=p, checkpoint_every=1
+            )
+            assert resumed.stats["telemetry"]["counters"] == totals
+            # a telemetry-OFF resume must not report the saved snapshot
+            # as this render's totals (it covers none of this process's
+            # work) — but the checkpoint keeps carrying it forward so a
+            # later telemetry-on resume still reports true totals
+            os.environ["TPU_PBRT_TELEMETRY"] = "0"
+            config.reload()
+            off = integ.render(scene, checkpoint_path=p, checkpoint_every=1)
+            assert "telemetry" not in off.stats
+            _, _, _, ctr = load_checkpoint(p)
+            assert ctr == totals
+        finally:
+            del os.environ["TPU_PBRT_CHUNK"]
+            os.environ.pop("TPU_PBRT_TELEMETRY", None)

@@ -37,6 +37,22 @@ python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck
 echo "== jaxpr audit + jaxcost budget gate + shardcheck (python -m tpu_pbrt.analysis)"
 python -m tpu_pbrt.analysis
 
+# telemetry smoke (ISSUE 4): render a cropped cornell through the real
+# CLI with --trace + the flight recorder, then gate on the artifacts —
+# the trace JSON must validate against the Chrome-trace schema and the
+# flight JSONL must carry >= 1 heartbeat for every render phase.
+echo "== telemetry smoke: --trace render + trace/flight validation"
+SMOKE_DIR="$(mktemp -d)"
+trap 'rm -rf "$SMOKE_DIR"' EXIT
+XLA_FLAGS="${XLA_FLAGS:-} --xla_backend_optimization_level=0" \
+TPU_PBRT_FLIGHT_PATH="$SMOKE_DIR/flight.jsonl" \
+python -m tpu_pbrt.main scenes/cornell-path.pbrt --quick --quiet \
+    --cropwindow 0 0.25 0 0.25 \
+    -o "$SMOKE_DIR/smoke.pfm" --trace "$SMOKE_DIR/trace.json"
+python -m tpu_pbrt.obs "$SMOKE_DIR/trace.json" \
+    --flight "$SMOKE_DIR/flight.jsonl" \
+    --require-phases render,render_done,develop --min-spans 3
+
 if [[ "${1:-}" == "--fast" ]]; then
     echo "== pytest skipped (--fast)"
     exit 0

@@ -274,7 +274,11 @@ def mesh_step_jaxpr():
     import jax.numpy as jnp
 
     from tpu_pbrt.core.film import merge_film
-    from tpu_pbrt.parallel.mesh import make_mesh, sharded_pool_renderer
+    from tpu_pbrt.parallel.mesh import (
+        device_spread,
+        make_mesh,
+        sharded_pool_renderer,
+    )
 
     scene, integ = _stream_scene("path")
     film = scene.film
@@ -282,11 +286,19 @@ def mesh_step_jaxpr():
     mesh = make_mesh(n_dev)
 
     def per_device_fn(dev, start):
-        fs2, nrays, live, waves, trunc = integ.pool_chunk(
+        # telemetry counters AND the one-hot wave-spread vector ride the
+        # aux psum exactly as the real render loop threads them
+        # (common.py per_device_fn), so the audited program IS the
+        # dispatched one — a regression inside device_spread or the
+        # counter carry must drift this fingerprint and fail the budget/
+        # shardcheck gates; both are None (empty pytrees) under
+        # TPU_PBRT_TELEMETRY=0
+        fs2, nrays, live, waves, trunc, ctr = integ.pool_chunk(
             dev, film.init_state(), start[0, 0], start[0, 1], 128, 64,
             film=film, cam=scene.camera,
         )
-        return fs2, (nrays, live, waves, trunc)
+        spread = device_spread(waves, n_dev) if ctr is not None else None
+        return fs2, (nrays, live, waves, trunc, ctr, spread)
 
     step = sharded_pool_renderer(mesh, per_device_fn)
 

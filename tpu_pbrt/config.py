@@ -86,6 +86,9 @@ class Config:
         "audit_drops",
         "allow_drops",
         "shard_native_check",
+        "telemetry",
+        "trace_path",
+        "flight_path",
     )
 
     def _load(self) -> "Config":
@@ -133,6 +136,21 @@ class Config:
         self.shard_native_check: Optional[bool] = _triflag(
             "TPU_PBRT_SHARD_NATIVE_CHECK"
         )
+        #: runtime telemetry (tpu_pbrt/obs): device-side wave counters in
+        #: the pool drain, host-side trace spans and flight heartbeats.
+        #: 0 is the kill switch — the drain compiles to the exact
+        #: pre-telemetry program (the counter carry is a None pytree leaf)
+        self.telemetry: bool = _flag("TPU_PBRT_TELEMETRY", True)
+        #: Chrome-trace/Perfetto JSON output path for the span recorder
+        #: (also settable per-run via --trace on main.py / bench.py)
+        self.trace_path: Optional[str] = os.environ.get(
+            "TPU_PBRT_TRACE_PATH"
+        ) or None
+        #: append-only JSONL flight-recorder path (phase heartbeats +
+        #: counter snapshots; bench.py defaults this when unset)
+        self.flight_path: Optional[str] = os.environ.get(
+            "TPU_PBRT_FLIGHT_PATH"
+        ) or None
         return self
 
 

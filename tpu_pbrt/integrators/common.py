@@ -773,7 +773,15 @@ class WavefrontIntegrator:
         # of the same scene — bench warmup, spp-chunked loops, resumed
         # checkpoints — hit the compile cache. The cache holds a strong ref
         # to the scene, keeping the keyed identity stable.
-        jit_key = (scene, mesh, chunk, spp, total, n_dev, pool, use_regen)
+        # the telemetry kill switch changes the traced program (counter
+        # carry present/absent), so it is part of the closure identity —
+        # a reload() between renders must not reuse the stale closure
+        from tpu_pbrt.obs import counters as _obs_counters
+
+        jit_key = (
+            scene, mesh, chunk, spp, total, n_dev, pool, use_regen,
+            _obs_counters.enabled(),
+        )
         cached = getattr(self, "_jit_cache", None)
         if cached is not None and all(
             a is b if i < 2 else a == b for i, (a, b) in enumerate(zip(cached[0], jit_key))
@@ -783,26 +791,38 @@ class WavefrontIntegrator:
             if use_regen and mesh is None:
 
                 def chunk_fn(state: FilmState, dev, start_pix, start_s):
-                    fs2, nrays, live, waves, trunc = self.pool_chunk(
+                    fs2, nrays, live, waves, trunc, ctr = self.pool_chunk(
                         dev, state, start_pix, start_s, chunk, pool,
                         film=film, cam=cam,
                     )
-                    return fs2, (nrays, live, waves, trunc)
+                    # ctr is None under TPU_PBRT_TELEMETRY=0 — an empty
+                    # pytree leaf, so the killed program is unchanged
+                    return fs2, (nrays, live, waves, trunc, ctr)
 
                 jfn = jax.jit(chunk_fn, donate_argnums=(0,))
             elif use_regen:
-                from tpu_pbrt.parallel.mesh import sharded_pool_renderer
+                from tpu_pbrt.parallel.mesh import (
+                    device_spread,
+                    sharded_pool_renderer,
+                )
 
                 def per_device_fn(dev, start):
                     # each device drains ITS work slice [start, start +
                     # per_dev) with its own resident pool and work counter
                     # (see sharded_pool_renderer for the lockstep-freedom
                     # contract)
-                    fs2, nrays, live, waves, trunc = self.pool_chunk(
+                    fs2, nrays, live, waves, trunc, ctr = self.pool_chunk(
                         dev, film.init_state(), start[0, 0], start[0, 1],
                         per_dev, pool, film=film, cam=cam,
                     )
-                    return fs2, (nrays, live, waves, trunc)
+                    # the one-hot wave vector rides the aux psum out as
+                    # the per-device wave-count spread (ROADMAP multi-
+                    # chip metric); None when telemetry is killed
+                    spread = (
+                        device_spread(waves, n_dev)
+                        if ctr is not None else None
+                    )
+                    return fs2, (nrays, live, waves, trunc, ctr, spread)
 
                 step = sharded_pool_renderer(mesh, per_device_fn)
 
@@ -883,10 +903,17 @@ class WavefrontIntegrator:
         checkpoint_every = checkpoint_every or getattr(self.options, "checkpoint_every", 0)
         first_chunk = 0
         prev_rays = 0
+        prev_ctr: Dict[str, Any] = {}
         state = film.init_state()
         fp = render_fingerprint(chunk=chunk, spp=spp, total=total, scene=scene)
         if ckpt_path and _os.path.exists(ckpt_path):
-            state, first_chunk, prev_rays = load_checkpoint(ckpt_path, fp)
+            state, first_chunk, prev_rays, prev_ctr = load_checkpoint(
+                ckpt_path, fp
+            )
+
+        from tpu_pbrt.obs import counters as obs_counters
+        from tpu_pbrt.obs.flight import FLIGHT
+        from tpu_pbrt.obs.trace import TRACE
 
         if cfg.audit_drops and "tstream" in dev:
             # Capacity audit, DEFAULT ON, BEFORE the render loop (an
@@ -930,11 +957,13 @@ class WavefrontIntegrator:
 
                 self._audit_jit = (audit_key, audit_rays)
 
-            o0, d0 = audit_rays()
-            *_, drops, _ = stream_traverse_stats(
-                dev["tstream"], o0, d0, jax.device_put(np.float32(np.inf))
-            )
-            drops = int(jax.device_get(drops))
+            with TRACE.span("render/capacity_audit"):
+                o0, d0 = audit_rays()
+                *_, drops, _ = stream_traverse_stats(
+                    dev["tstream"], o0, d0,
+                    jax.device_put(np.float32(np.inf)),
+                )
+                drops = int(jax.device_get(drops))
             if drops > 0:
                 msg = (
                     f"stream tracer dropped {drops} traversal pairs to "
@@ -952,7 +981,26 @@ class WavefrontIntegrator:
         progress = ProgressReporter(n_chunks, "Rendering", quiet=quiet)
         ray_counts = []
         occ_counts = []  # regen mode: (live lane-waves, waves) per chunk
+        ctr_counts = []  # telemetry: per-chunk WaveCounters (device side)
+        spread_counts = []  # telemetry (mesh): per-device wave vectors
+
+        def ctr_snapshot():
+            """Cumulative host counter dict (checkpoint payload / final
+            stats): the saved snapshot + everything fetched so far. The
+            device_get inside to_host is the telemetry's one explicit
+            drain-boundary fetch (checkpoint writes are drain
+            boundaries too)."""
+            return obs_counters.merge_host(
+                prev_ctr, obs_counters.to_host(ctr_counts)
+            )
+
         chunks_done = first_chunk
+        FLIGHT.heartbeat(
+            "render", chunks=n_chunks, resumed_at=first_chunk, spp=spp,
+        )
+        # heartbeat cadence: bounded line count on long renders, but
+        # every chunk on short ones so the flight timeline has substance
+        hb_every = max(1, n_chunks // 16)
         t0 = time.time()
         c = first_chunk
         attempt = 0
@@ -972,10 +1020,19 @@ class WavefrontIntegrator:
                     if hook is not None:
                         hook(c, attempt)
                     try:
-                        if mesh is None:
-                            state, aux = jfn(state, dev, st[0], st[1])
-                        else:
-                            state, aux = jfn(state, dev, st)
+                        # the first dispatch blocks the host on jit
+                        # trace+compile; later ones are async enqueues —
+                        # the span names keep the two distinguishable in
+                        # the exported trace
+                        with TRACE.span(
+                            "render/chunk_dispatch+compile"
+                            if c == first_chunk else "render/chunk_dispatch",
+                            chunk=c,
+                        ):
+                            if mesh is None:
+                                state, aux = jfn(state, dev, st[0], st[1])
+                            else:
+                                state, aux = jfn(state, dev, st)
                     except jax.errors.JaxRuntimeError as e:
                         # real device/runtime loss mid-dispatch: the donated
                         # film accumulator can no longer be trusted — route
@@ -992,36 +1049,58 @@ class WavefrontIntegrator:
                             f"chunk {c} failed {attempt} times"
                         ) from e
                     if e.poisons_state and ckpt_path and _os.path.exists(ckpt_path):
-                        state, c, prev_rays = load_checkpoint(ckpt_path, fp)
+                        state, c, prev_rays, prev_ctr = load_checkpoint(
+                            ckpt_path, fp
+                        )
                         ray_counts.clear()
                         occ_counts.clear()
+                        ctr_counts.clear()
+                        spread_counts.clear()
                     elif e.poisons_state:
                         # no durable state to roll back to: restart the render
                         state = film.init_state()
                         c = 0
                         prev_rays = 0
+                        prev_ctr = {}
                         ray_counts.clear()
                         occ_counts.clear()
+                        ctr_counts.clear()
+                        spread_counts.clear()
+                    FLIGHT.heartbeat(
+                        "render_redispatch", chunk=c, attempt=attempt,
+                        poisoned=e.poisons_state, error=str(e)[:200],
+                    )
                     continue
                 attempt = 0
                 c += 1
                 if use_regen:
-                    nrays, lv, wv, trunc = aux
+                    nrays, lv, wv, trunc = aux[:4]
                     occ_counts.append((lv, wv, trunc))
+                    if len(aux) > 4 and aux[4] is not None:
+                        ctr_counts.append(aux[4])
+                    if len(aux) > 5 and aux[5] is not None:
+                        spread_counts.append(aux[5])
                 else:
                     nrays = aux
                 ray_counts.append(nrays)  # defer the sync: keep the pipe full
                 progress.update()
                 chunks_done = c
-                if ckpt_path and checkpoint_every and c % checkpoint_every == 0:
-                    save_checkpoint(
-                        ckpt_path,
-                        state,
-                        c,
-                        prev_rays
-                        + sum(int(r) for r in jax.device_get(ray_counts)),
-                        fingerprint=fp,
+                if c == first_chunk + 1 or c % hb_every == 0:
+                    FLIGHT.heartbeat(
+                        "render", chunk=c, of=n_chunks,
+                        render_s=round(time.time() - t0, 3),
                     )
+                if ckpt_path and checkpoint_every and c % checkpoint_every == 0:
+                    with TRACE.span("render/checkpoint", chunk=c):
+                        save_checkpoint(
+                            ckpt_path,
+                            state,
+                            c,
+                            prev_rays
+                            + sum(int(r) for r in jax.device_get(ray_counts)),
+                            fingerprint=fp,
+                            counters=ctr_snapshot(),
+                        )
                 if max_seconds > 0:
                     # time-boxed mode: block on a chunk a few dispatches
                     # BACK, so the wall clock tracks completed work while
@@ -1043,7 +1122,10 @@ class WavefrontIntegrator:
                     )
                     if time.time() - t0 > max_seconds:
                         break
-            jax.block_until_ready(state)
+            # device execution of the queued wave batches (and, on a
+            # mesh, the ICI film psum/merge) completes inside this sync
+            with TRACE.span("render/wave_drain+film_merge"):
+                jax.block_until_ready(state)
         secs = time.time() - t0
         progress.done()
         completed_fraction = chunks_done / max(n_chunks, 1)
@@ -1051,22 +1133,35 @@ class WavefrontIntegrator:
         STATS.counter("Integrator/Rays traced", rays)
         STATS.counter("Integrator/Camera rays traced", total)
         STATS.distribution("Integrator/Rays per camera ray", rays / max(total, 1))
+        # the drain-boundary counter fetch (the telemetry's ONE
+        # device_get for the whole render when no checkpoints fired)
+        ctr_total = ctr_snapshot()
+        if obs_counters.enabled() and ctr_total:
+            FLIGHT.counters(ctr_total, phase="render_done")
+        else:
+            FLIGHT.heartbeat("render_done", rays=rays, seconds=round(secs, 3))
         if ckpt_path:
-            save_checkpoint(ckpt_path, state, chunks_done, rays, fingerprint=fp)
+            save_checkpoint(
+                ckpt_path, state, chunks_done, rays, fingerprint=fp,
+                counters=ctr_total,
+            )
         # pbrt film.cpp WriteImage splatScale: splats (BDPT t=1, MLT, SPPM)
         # are deposited once per SAMPLE, so the developed image divides by
         # the number of samples actually taken — a time-boxed partial
         # render deposited only completed_fraction of them (the rgb plane
         # self-normalizes via its weight sum; the splat plane cannot)
         n_splat_samples = max(spp * completed_fraction, 1e-9)
-        img = film.develop(state, splat_scale=1.0 / n_splat_samples)
+        with TRACE.span("render/develop"):
+            img = film.develop(state, splat_scale=1.0 / n_splat_samples)
+        FLIGHT.heartbeat("develop")
         if film.filename:
-            try:
-                film.write_image(state, splat_scale=1.0 / n_splat_samples)
-            except Exception as e:  # noqa: BLE001
-                from tpu_pbrt.utils.error import Warning as _W
+            with TRACE.span("render/write_image"):
+                try:
+                    film.write_image(state, splat_scale=1.0 / n_splat_samples)
+                except Exception as e:  # noqa: BLE001
+                    from tpu_pbrt.utils.error import Warning as _W
 
-                _W(f"could not write image {film.filename}: {e}")
+                    _W(f"could not write image {film.filename}: {e}")
         stats: Dict[str, Any] = {}
         if use_regen and occ_counts:
             occ_host = jax.device_get(occ_counts)
@@ -1098,6 +1193,32 @@ class WavefrontIntegrator:
             STATS.distribution(
                 "Integrator/Wave occupancy", stats["mean_wave_occupancy"]
             )
+        if obs_counters.enabled() and ctr_total:
+            # the telemetry block: cumulative counters (checkpoint-
+            # seeded, so resumed renders report end-to-end totals) and
+            # the per-device wave-count spread (ROADMAP multi-chip
+            # metric; degenerate single entry off-mesh). Gated on the
+            # kill switch, NOT just on the snapshot: a telemetry-off
+            # resume of a telemetry-on checkpoint has a non-empty saved
+            # snapshot that covers none of THIS process's work — report
+            # nothing rather than stale partials as end-to-end totals
+            # (the checkpoint keeps carrying the snapshot forward so a
+            # later telemetry-on resume still reports true totals)
+            if spread_counts:
+                spread_host = jax.device_get(spread_counts)
+                per_dev = [
+                    int(sum(v[i] for v in spread_host))
+                    for i in range(len(spread_host[0]))
+                ]
+            elif use_regen and occ_counts:
+                per_dev = [sum(int(b) for _, b, _ in occ_host)]
+            else:
+                per_dev = []
+            stats["telemetry"] = {
+                "counters": ctr_total,
+                "wave_spread": obs_counters.spread_stats(per_dev),
+            }
+        TRACE.maybe_export()
         return RenderResult(
             image=img,
             film_state=state,

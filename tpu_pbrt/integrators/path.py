@@ -37,7 +37,7 @@ materials, multi-segment Tr, the halton sampler's scalar-salt dispatch).
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -150,7 +150,7 @@ class PathIntegrator(WavefrontIntegrator):
     # -- one wavefront step ------------------------------------------------
     def _bounce_wave(
         self, dev, px, py, s, salt, ray_time, st: LaneSt, nrays,
-        *, fused: bool, scalar_bounce=None,
+        *, fused: bool, scalar_bounce=None, ctr=None,
     ):
         """Advance every lane one bounce: trace (fused continuation +
         pending-shadow 2R wave when `fused`), settle the previous bounce's
@@ -164,9 +164,13 @@ class PathIntegrator(WavefrontIntegrator):
         so both modes draw the same streams). `scalar_bounce` enables the
         lax.cond skip of the camera-footprint block when the whole wave
         shares one bounce index; pool mode (None) masks per-lane instead.
-        Returns (LaneSt, nrays + this wave's per-lane traced-ray counts).
+        `ctr` is the optional telemetry counter block (obs/counters.py):
+        this wave's ray count and occupancy-histogram bin are folded in
+        here, structural drain counters in the pool body. Returns
+        (LaneSt, nrays + this wave's per-lane traced-ray counts, ctr).
         """
         shape = st.o.shape[:-1]
+        nrays_in = nrays  # telemetry: the wave's ray delta (ctr below)
         o, d, L, beta, alive = st.o, st.d, st.L, st.beta, st.alive
         depth, prev_pdf, specular = st.depth, st.prev_pdf, st.specular
         eta_scale, prev_p = st.eta_scale, st.prev_p
@@ -537,10 +541,16 @@ class PathIntegrator(WavefrontIntegrator):
             pend = (sh_o_n, sh_d_n, sh_dist_n, ld_pend_n)
         else:
             pend = (st.sh_o, st.sh_d, st.sh_dist, st.ld_pend)
+        if ctr is not None:
+            from tpu_pbrt.obs import counters as obs_counters
+
+            ctr = obs_counters.bounce_update(
+                ctr, alive=st.alive, rays_before=nrays_in, rays_after=nrays
+            )
         return LaneSt(
             o, d, L, beta, alive, depth, prev_pdf, specular, eta_scale,
             prev_p, *pend,
-        ), nrays
+        ), nrays, ctr
 
     # -- fixed-batch loop (TPU_PBRT_REGEN=0 fallback; non-fused scenes) ----
     def li(self, dev, o, d, px, py, s):
@@ -578,7 +588,7 @@ class PathIntegrator(WavefrontIntegrator):
 
         def body(st: St):
             salt = st.bounce * DIMS_PER_BOUNCE
-            lane, nrays = self._bounce_wave(
+            lane, nrays, _ = self._bounce_wave(
                 dev, px, py, s, salt, ray_time, st.lane, st.nrays,
                 fused=fused, scalar_bounce=st.bounce,
             )
@@ -612,11 +622,16 @@ class PathIntegrator(WavefrontIntegrator):
         fused layout settles NEE one wave late) before depositing.
 
         Returns (film_state, rays_traced, live_lane_waves, n_waves,
-        truncated): mean wave occupancy = live_lane_waves / (n_waves *
-        pool); truncated is 1 if the max_waves safety cutoff fired with
-        work still outstanding (the caller warns loudly — a silently
-        darker image must never pass as a completed render).
+        truncated, counters): mean wave occupancy = live_lane_waves /
+        (n_waves * pool); truncated is 1 if the max_waves safety cutoff
+        fired with work still outstanding (the caller warns loudly — a
+        silently darker image must never pass as a completed render);
+        counters is the telemetry WaveCounters block carried through the
+        drain (None under TPU_PBRT_TELEMETRY=0 — an empty pytree leaf,
+        so the killed program is the exact pre-telemetry one).
         """
+        from tpu_pbrt.obs import counters as obs_counters
+
         assert pool < (1 << _POOL_LANE_BITS)
         film = film if film is not None else self.scene.film
         cam = cam if cam is not None else self.scene.camera
@@ -643,6 +658,7 @@ class PathIntegrator(WavefrontIntegrator):
             nrays: jnp.ndarray
             live: jnp.ndarray  # sum of live lanes over waves (occupancy)
             waves: jnp.ndarray
+            ctr: Any  # WaveCounters | None (None = telemetry killed)
 
         def cond(ps: PSt):
             return ((ps.cursor < n_work) | jnp.any(ps.has_work)) & (
@@ -695,17 +711,33 @@ class PathIntegrator(WavefrontIntegrator):
             has_work = active | can
 
             live = ps.live + jnp.sum(lane.alive, dtype=jnp.int32)
+            alive_pre = lane.alive
 
             # ---- one bounce wave -------------------------------------
             salt = lane.depth * DIMS_PER_BOUNCE
-            lane, nray_d = self._bounce_wave(
+            lane, nray_d, ctr = self._bounce_wave(
                 dev, px, py, s, salt, tl if motion else None, lane,
                 jnp.zeros((pool,), jnp.int32), fused=True,
-                scalar_bounce=None,
+                scalar_bounce=None, ctr=ps.ctr,
             )
 
             # ---- scatter-on-terminate film deposit -------------------
             done = has_work & ~lane.alive & ~(lane.sh_dist > 0.0)
+            if ctr is not None:
+                # structural drain counters (rays/occupancy were folded
+                # in by _bounce_wave): all pure in-loop i32 reductions,
+                # fetched once at the drain boundary with the rest of aux
+                ctr = obs_counters.pool_update(
+                    ctr,
+                    regenerated=jnp.sum(can, dtype=jnp.int32),
+                    terminated=jnp.sum(
+                        alive_pre & ~lane.alive, dtype=jnp.int32
+                    ),
+                    deposits=jnp.sum(done, dtype=jnp.int32),
+                    compacted=jnp.sum(
+                        active & (perm != lane_idx), dtype=jnp.int32
+                    ),
+                )
             if box_fast:
                 # box(0.5): one masked own-pixel scatter, matching the
                 # aligned path the fixed-batch single-device render uses
@@ -730,6 +762,7 @@ class PathIntegrator(WavefrontIntegrator):
                 nrays=ps.nrays + jnp.sum(nray_d),
                 live=live,
                 waves=ps.waves + 1,
+                ctr=ctr,
             )
 
         zero3 = jnp.zeros((pool, 3), jnp.float32)
@@ -751,9 +784,10 @@ class PathIntegrator(WavefrontIntegrator):
             nrays=jnp.int32(0),
             live=jnp.int32(0),
             waves=jnp.int32(0),
+            ctr=obs_counters.maybe_zeros(),
         )
         out = jax.lax.while_loop(cond, body, init)
         truncated = (
             (out.cursor < n_work) | jnp.any(out.has_work)
         ).astype(jnp.int32)
-        return out.fs, out.nrays, out.live, out.waves, truncated
+        return out.fs, out.nrays, out.live, out.waves, truncated, out.ctr

@@ -43,6 +43,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="initialize jax.distributed (multi-host pod rendering over DCN; "
         "also auto-enabled by JAX_COORDINATOR_ADDRESS)",
     )
+    p.add_argument(
+        "--trace",
+        default="",
+        metavar="OUT.json",
+        help="export a Chrome-trace/Perfetto span timeline of the render "
+        "phases (also settable via TPU_PBRT_TRACE_PATH); view at "
+        "ui.perfetto.dev",
+    )
     return p
 
 
@@ -61,16 +69,26 @@ def main(argv=None) -> int:
         checkpoint_every=args.checkpoint_every,
         multihost=args.multihost,
     )
+    from tpu_pbrt.obs.trace import TRACE
     from tpu_pbrt.parallel.mesh import maybe_init_distributed
 
+    if args.trace:
+        TRACE.configure(args.trace)
     maybe_init_distributed(opts)
-    for scene in args.scenes:
-        try:
-            render_file(scene, opts)
-        except PbrtError as e:
-            print(f"tpu-pbrt: {e}", file=sys.stderr)
-            return 1
-    return 0
+    try:
+        for scene in args.scenes:
+            try:
+                with TRACE.span("main/render_file", scene=scene):
+                    render_file(scene, opts)
+            except PbrtError as e:
+                print(f"tpu-pbrt: {e}", file=sys.stderr)
+                return 1
+        return 0
+    finally:
+        # render() exports incrementally; this export catches the outer
+        # main/render_file spans — and runs on the FAILURE path too,
+        # where the trace matters most
+        TRACE.maybe_export()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,71 @@
+"""`python -m tpu_pbrt.obs` — validate exported telemetry artifacts.
+
+    python -m tpu_pbrt.obs trace.json \
+        --flight flight.jsonl --require-phases render,develop
+
+Exit 0 iff every named artifact validates: the trace JSON loads in
+Perfetto (schema check, no browser needed) and the flight JSONL carries
+>= 1 heartbeat for every required phase. This is the CI smoke stage's
+gate (tools/ci.sh) and is importable from tests via
+trace.validate_trace / flight.validate_flight.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpu_pbrt.obs.flight import validate_flight
+from tpu_pbrt.obs.trace import validate_trace
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tpu_pbrt.obs")
+    ap.add_argument(
+        "trace", nargs="?", help="Chrome-trace JSON file to validate"
+    )
+    ap.add_argument(
+        "--flight", default="", help="flight-recorder JSONL file to validate"
+    )
+    ap.add_argument(
+        "--require-phases", default="",
+        help="comma-separated phases the flight file must each have "
+             ">= 1 heartbeat for",
+    )
+    ap.add_argument(
+        "--min-spans", type=int, default=1,
+        help="minimum number of trace events required (default 1)",
+    )
+    args = ap.parse_args(argv)
+    if not args.trace and not args.flight:
+        ap.error("nothing to validate: pass a trace file and/or --flight")
+
+    problems = []
+    if args.trace:
+        errs = validate_trace(args.trace)
+        problems += [f"trace: {e}" for e in errs]
+        if not errs:
+            import json
+
+            with open(args.trace) as f:
+                n = len(json.load(f)["traceEvents"])
+            if n < args.min_spans:
+                problems.append(
+                    f"trace: only {n} events (need >= {args.min_spans})"
+                )
+            else:
+                print(f"trace OK: {args.trace} ({n} events)")
+    if args.flight:
+        phases = [p for p in args.require_phases.split(",") if p]
+        errs = validate_flight(args.flight, require_phases=phases)
+        problems += [f"flight: {e}" for e in errs]
+        if not errs:
+            print(f"flight OK: {args.flight} (phases: {phases or 'any'})")
+
+    for p in problems:
+        print(f"FAIL {p}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

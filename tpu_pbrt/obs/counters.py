@@ -1,0 +1,178 @@
+"""Device-side per-wave counter block for the persistent-wavefront drain.
+
+The counters are pure `jnp` state carried through the `pool_chunk`
+while_loop (and updated per wave inside `_bounce_wave`), psum-merged
+across devices by the mesh drain, and fetched ONCE at the drain boundary
+together with the ray/occupancy aux — never mid-loop, so the bounce loop
+stays clean under `jax.transfer_guard("disallow")` and adds zero
+retraces (the jaxpr-audit gates keep watching both).
+
+Kill switch: `TPU_PBRT_TELEMETRY=0`. A disabled counter block is carried
+as `None`, which is an EMPTY jax pytree — the loop carry contributes no
+avals and the compiled program is the exact pre-telemetry one, not a
+masked variant of it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+#: occupancy histogram resolution: bin k counts waves whose live-lane
+#: fraction fell in [k/N, (k+1)/N) (a full wave lands in the last bin)
+N_OCC_BINS = 8
+
+#: host-dict field names, in WaveCounters field order
+HOST_FIELDS = (
+    "rays_traced",
+    "lanes_regenerated",
+    "lanes_terminated",
+    "film_deposits",
+    "lanes_compacted",
+    "occupancy_histogram",
+)
+
+
+class WaveCounters(NamedTuple):
+    """Per-drain counter block; every field is an int32 device scalar
+    except the occupancy histogram (int32 [N_OCC_BINS])."""
+
+    #: rays traced (camera continuations + shadow + BSSRDF probe rays)
+    rays: jnp.ndarray
+    #: pool lanes refilled with fresh camera rays from the work counter
+    regenerated: jnp.ndarray
+    #: lanes whose path died this wave (miss / RR kill / maxdepth)
+    terminated: jnp.ndarray
+    #: film deposits (terminated lanes whose pending NEE also settled)
+    deposits: jnp.ndarray
+    #: live lanes relocated by the compaction sort (slot index changed)
+    compacted: jnp.ndarray
+    #: per-wave occupancy histogram (live lanes / pool width at trace time)
+    occ_hist: jnp.ndarray
+
+
+def enabled() -> bool:
+    """The kill-switch gate — a STATIC Python decision at trace time."""
+    from tpu_pbrt.config import cfg
+
+    return bool(cfg.telemetry)
+
+
+def zeros() -> WaveCounters:
+    """Fresh counter block (call inside jit: the arrays are staged)."""
+    z = jnp.int32(0)
+    return WaveCounters(
+        rays=z,
+        regenerated=z,
+        terminated=z,
+        deposits=z,
+        compacted=z,
+        occ_hist=jnp.zeros((N_OCC_BINS,), jnp.int32),
+    )
+
+
+def maybe_zeros() -> Optional[WaveCounters]:
+    """zeros() when telemetry is on, None (empty pytree) when killed."""
+    return zeros() if enabled() else None
+
+
+def bounce_update(
+    ctr: Optional[WaveCounters], *, alive, rays_before, rays_after
+) -> Optional[WaveCounters]:
+    """One trace wave's worth of counting, from inside `_bounce_wave`:
+    rays dispatched this wave and the occupancy-histogram bin of the
+    wave's live-lane fraction. `alive` is the pre-trace live mask (the
+    lanes that actually cost traversal), rays_before/after the per-lane
+    ray accumulators around the wave."""
+    if ctr is None:
+        return None
+    width = alive.shape[0]
+    live = jnp.sum(alive, dtype=jnp.int32)
+    wave_rays = jnp.sum(rays_after - rays_before, dtype=jnp.int32)
+    bin_ix = jnp.clip(live * N_OCC_BINS // width, 0, N_OCC_BINS - 1)
+    return ctr._replace(
+        rays=ctr.rays + wave_rays,
+        occ_hist=ctr.occ_hist.at[bin_ix].add(1),
+    )
+
+
+def pool_update(
+    ctr: Optional[WaveCounters], *, regenerated, terminated, deposits,
+    compacted,
+) -> Optional[WaveCounters]:
+    """The drain-loop structural counters, from the `pool_chunk` body:
+    each argument is this wave's int32 count."""
+    if ctr is None:
+        return None
+    return ctr._replace(
+        regenerated=ctr.regenerated + regenerated,
+        terminated=ctr.terminated + terminated,
+        deposits=ctr.deposits + deposits,
+        compacted=ctr.compacted + compacted,
+    )
+
+
+# -- host side (the one fetch at the drain boundary) -----------------------
+
+
+def to_host(ctrs: Iterable[WaveCounters]) -> Dict[str, Any]:
+    """Fetch a list of per-chunk counter blocks with ONE device_get and
+    sum them into the canonical host dict (ints + histogram list)."""
+    ctrs = list(ctrs)
+    if not ctrs:
+        return {}
+    host = jax.device_get(ctrs)
+    out: Dict[str, Any] = {k: 0 for k in HOST_FIELDS}
+    out["occupancy_histogram"] = [0] * N_OCC_BINS
+    for c in host:
+        out["rays_traced"] += int(c.rays)
+        out["lanes_regenerated"] += int(c.regenerated)
+        out["lanes_terminated"] += int(c.terminated)
+        out["film_deposits"] += int(c.deposits)
+        out["lanes_compacted"] += int(c.compacted)
+        hist = [int(v) for v in c.occ_hist]
+        out["occupancy_histogram"] = [
+            a + b for a, b in zip(out["occupancy_histogram"], hist)
+        ]
+    return out
+
+
+def merge_host(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    """Sum two host counter dicts (checkpoint-resume seeding: the saved
+    cumulative snapshot + this process's drain)."""
+    if not a:
+        return dict(b)
+    if not b:
+        return dict(a)
+    out: Dict[str, Any] = {}
+    for k in set(a) | set(b):
+        va, vb = a.get(k), b.get(k)
+        if isinstance(va, list) or isinstance(vb, list):
+            va = va or []
+            vb = vb or []
+            n = max(len(va), len(vb))
+            va = va + [0] * (n - len(va))
+            vb = vb + [0] * (n - len(vb))
+            out[k] = [int(x) + int(y) for x, y in zip(va, vb)]
+        else:
+            out[k] = int(va or 0) + int(vb or 0)
+    return out
+
+
+def spread_stats(per_device_waves) -> Dict[str, Any]:
+    """Per-device wave-count spread (the ROADMAP multi-chip metric): how
+    unevenly the independent per-device drains ran. rel_spread =
+    (max - min) / mean; 0 on a single device or a perfectly even mesh."""
+    waves = [int(w) for w in per_device_waves]
+    if not waves:
+        return {}
+    mean = sum(waves) / len(waves)
+    return {
+        "per_device_waves": waves,
+        "min": min(waves),
+        "max": max(waves),
+        "mean": mean,
+        "rel_spread": (max(waves) - min(waves)) / max(mean, 1e-9),
+    }

@@ -1,0 +1,128 @@
+"""Append-only JSONL flight recorder.
+
+BENCH_r05 recorded `0.0` with nothing but "backend unreachable" — no
+record of which phase died, how long the probe waited, or what the last
+completed work looked like. The flight recorder fixes that class of
+capture: every phase writes heartbeat lines (`{"t", "elapsed_s",
+"phase", ...fields}`) to an append-only JSONL file, each line flushed to
+disk immediately, so whatever kills the process leaves the full
+phase timeline plus the last counter snapshot behind.
+
+Process-global `FLIGHT`, configured by `TPU_PBRT_FLIGHT_PATH` or
+programmatically (bench.py defaults a path so outage captures always
+carry a diagnosis). Unconfigured or with `TPU_PBRT_TELEMETRY=0` the
+heartbeats still track `last_phase` in memory (bench's outage JSON
+reports it either way) but write nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+
+class FlightRecorder:
+    def __init__(self):
+        self._path: Optional[str] = None
+        self._t0 = time.time()
+        self.last_phase: Optional[str] = None
+        self.last_counters: Optional[Dict[str, Any]] = None
+
+    def configure(self, path: Optional[str], t0: Optional[float] = None):
+        """t0 rebases elapsed_s (epoch seconds): a caller that heartbeat
+        with its own writer before this module could import (bench's
+        import-free probe phase) hands its start time over so one JSONL
+        file keeps a single monotonic elapsed_s baseline."""
+        self._path = path or None
+        if t0 is not None:
+            self._t0 = t0
+
+    @property
+    def path(self) -> Optional[str]:
+        from tpu_pbrt.config import cfg
+
+        return self._path or cfg.flight_path
+
+    @property
+    def enabled(self) -> bool:
+        from tpu_pbrt.config import cfg
+
+        return bool(cfg.telemetry and self.path)
+
+    def heartbeat(self, phase: str, **fields):
+        """One JSONL line: wall clock, elapsed seconds, phase, fields.
+        Opened/flushed/closed per line — crash-safe by construction."""
+        self.last_phase = phase
+        if not self.enabled:
+            return
+        line = {
+            "t": round(time.time(), 3),
+            "elapsed_s": round(time.time() - self._t0, 3),
+            "phase": phase,
+        }
+        # reserved keys win: a caller kwarg must not clobber the
+        # recorder's monotonic elapsed_s baseline (or t/phase)
+        for k, v in fields.items():
+            if k not in line:
+                line[k] = v
+        try:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(line) + "\n")
+        except OSError:
+            # a full/readonly disk must never kill the render it's
+            # supposed to be diagnosing
+            pass
+
+    def counters(self, snapshot: Dict[str, Any], phase: str = "counters"):
+        """Record the latest device-counter snapshot (the drain-boundary
+        fetch) so a post-mortem knows the last completed work."""
+        self.last_counters = dict(snapshot)
+        self.heartbeat(phase, counters=snapshot)
+
+
+FLIGHT = FlightRecorder()
+
+
+# -- validation (tests + `python -m tpu_pbrt.obs` + CI smoke) --------------
+
+
+def validate_flight(path: str, require_phases=None) -> List[str]:
+    """Validate a flight-recorder JSONL file: every line parses, carries
+    t/elapsed_s/phase, and (optionally) each phase in `require_phases`
+    has >= 1 heartbeat. Returns a list of problems."""
+    errs: List[str] = []
+    phases_seen = set()
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        return [f"unreadable flight file: {e}"]
+    if not lines:
+        errs.append("flight file is empty (no heartbeats recorded)")
+    for i, raw in enumerate(lines):
+        if not raw.strip():
+            continue
+        where = f"line {i + 1}"
+        try:
+            rec = json.loads(raw)
+        except ValueError as e:
+            errs.append(f"{where}: not JSON: {e}")
+            continue
+        if not isinstance(rec, dict):
+            errs.append(f"{where}: not an object")
+            continue
+        if not isinstance(rec.get("phase"), str) or not rec.get("phase"):
+            errs.append(f"{where}: missing phase")
+        else:
+            phases_seen.add(rec["phase"])
+        for key in ("t", "elapsed_s"):
+            if not isinstance(rec.get(key), (int, float)):
+                errs.append(f"{where}: missing numeric {key}")
+    for phase in require_phases or ():
+        if phase not in phases_seen:
+            errs.append(
+                f"required phase {phase!r} has no heartbeat "
+                f"(saw: {sorted(phases_seen)})"
+            )
+    return errs

@@ -1,0 +1,160 @@
+"""Host-side span recorder with Chrome-trace/Perfetto JSON export.
+
+Records named spans around the render phases the HOST can see — jit
+build + first (compiling) dispatch, per-chunk wave-batch dispatches, the
+drain sync that covers device execution and the mesh film psum/merge,
+checkpoint writes, develop — into the Chrome trace-event format
+(`chrome://tracing` / https://ui.perfetto.dev load it directly).
+
+The recorder is a process-global (`TRACE`) configured by `--trace` on
+main.py / bench.py or `TPU_PBRT_TRACE_PATH`; unconfigured (or with
+`TPU_PBRT_TELEMETRY=0`) every call is a cheap no-op. Timestamps are
+microseconds from recorder start, as the trace-event spec expects.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+#: event phases we emit/accept: complete span, instant, counter, metadata
+_PHASES = ("X", "i", "C", "M")
+
+
+class TraceRecorder:
+    def __init__(self):
+        self._events: List[Dict[str, Any]] = []
+        self._path: Optional[str] = None
+        self._t0 = time.perf_counter()
+
+    # -- configuration -----------------------------------------------------
+    def configure(self, path: Optional[str]):
+        """Set (or clear) the export path; the --trace flag lands here."""
+        self._path = path or None
+
+    @property
+    def path(self) -> Optional[str]:
+        from tpu_pbrt.config import cfg
+
+        return self._path or cfg.trace_path
+
+    @property
+    def enabled(self) -> bool:
+        from tpu_pbrt.config import cfg
+
+        return bool(cfg.telemetry and self.path)
+
+    def reset(self):
+        self._events = []
+        self._t0 = time.perf_counter()
+
+    # -- recording ---------------------------------------------------------
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    @contextmanager
+    def span(self, name: str, **args):
+        """Record a complete ("ph": "X") span around the with-body."""
+        if not self.enabled:
+            yield
+            return
+        ts = self._now_us()
+        try:
+            yield
+        finally:
+            self._events.append({
+                "name": name, "ph": "X", "ts": ts,
+                "dur": self._now_us() - ts,
+                "pid": 0, "tid": 0, "args": args,
+            })
+
+    def instant(self, name: str, **args):
+        if not self.enabled:
+            return
+        self._events.append({
+            "name": name, "ph": "i", "ts": self._now_us(),
+            "pid": 0, "tid": 0, "s": "p", "args": args,
+        })
+
+    def counter(self, name: str, **values):
+        """A "C" counter event — Perfetto plots these as tracks."""
+        if not self.enabled:
+            return
+        self._events.append({
+            "name": name, "ph": "C", "ts": self._now_us(),
+            "pid": 0, "tid": 0, "args": values,
+        })
+
+    # -- export ------------------------------------------------------------
+    def export(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the Chrome trace JSON; returns the path written (None if
+        no path is configured). Rewrites the whole file each call, so
+        incremental exports (per render) are safe and the last one wins."""
+        path = path or self.path
+        if not path:
+            return None
+        doc = {
+            "traceEvents": self._events,
+            "displayTimeUnit": "ms",
+            "otherData": {"tool": "tpu-pbrt obs.trace"},
+        }
+        # atomic tmp+rename (the checkpoint.py pattern): a crash mid-
+        # export must leave the previous valid export intact, not a
+        # truncated JSON — the failure path is where the trace matters
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+    def maybe_export(self) -> Optional[str]:
+        """export() iff enabled — the render loop's exit hook."""
+        return self.export() if self.enabled else None
+
+
+#: the process-wide recorder every phase reports into
+TRACE = TraceRecorder()
+
+
+# -- schema validation (tests + `python -m tpu_pbrt.obs` + CI smoke) -------
+
+
+def validate_trace(doc) -> List[str]:
+    """Validate a Chrome-trace document (dict, or a path to one).
+    Returns a list of problems; empty means the file loads in Perfetto."""
+    errs: List[str] = []
+    if isinstance(doc, str):
+        try:
+            with open(doc) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            return [f"unreadable trace file: {e}"]
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        return ["top level must be an object with a traceEvents array"]
+    events = doc["traceEvents"]
+    if not isinstance(events, list):
+        return ["traceEvents is not an array"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errs.append(f"{where}: not an object")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev.get("name"):
+            errs.append(f"{where}: missing/empty name")
+        ph = ev.get("ph")
+        if ph not in _PHASES:
+            errs.append(f"{where}: unknown phase {ph!r}")
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            errs.append(f"{where}: bad ts {ts!r}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errs.append(f"{where}: complete span with bad dur {dur!r}")
+        for key in ("pid", "tid"):
+            if not isinstance(ev.get(key), int):
+                errs.append(f"{where}: missing integer {key}")
+    return errs

@@ -6,17 +6,27 @@ idempotent pure function of (scene, work range), a checkpoint is just the
 accumulated film pytree plus the chunk cursor. The counter-based RNG keyed
 on (pixel, sample, dimension) makes a resumed render bit-identical to an
 uninterrupted one. Written atomically (tmp + rename) so a crash mid-write
-leaves the previous checkpoint intact."""
+leaves the previous checkpoint intact.
+
+Format v3 adds the cumulative telemetry-counter snapshot (obs/counters
+host dict, JSON-encoded) so a resumed render reports END-TO-END totals —
+rays/regenerations/deposits across every process that touched the film,
+not just the last one. v2 files (no counter field) still load, with an
+empty snapshot."""
 
 from __future__ import annotations
 
+import json
 import os
+from typing import Any, Dict, Optional
 
 import numpy as np
 
 from tpu_pbrt.core.film import FilmState
 
-_FORMAT_VERSION = 2
+_FORMAT_VERSION = 3
+#: versions load_checkpoint still understands
+_COMPAT_VERSIONS = (2, 3)
 
 
 def save_checkpoint(
@@ -25,11 +35,13 @@ def save_checkpoint(
     next_chunk: int,
     rays_so_far: int,
     fingerprint: str = "",
+    counters: Optional[Dict[str, Any]] = None,
 ):
     """fingerprint encodes everything the chunk cursor's meaning depends on
     (chunk size, spp, work total, scene/film identity — see
     render_fingerprint); load_checkpoint refuses a mismatch rather than
-    silently misinterpreting the cursor (ADVICE r1)."""
+    silently misinterpreting the cursor (ADVICE r1). counters is the
+    cumulative telemetry snapshot (may be None/{} with telemetry killed)."""
     tmp = path + ".tmp"
     np.savez_compressed(
         tmp if tmp.endswith(".npz") else tmp,
@@ -40,6 +52,7 @@ def save_checkpoint(
         next_chunk=next_chunk,
         rays=rays_so_far,
         fingerprint=np.array(fingerprint),
+        counters=np.array(json.dumps(counters or {})),
     )
     # np.savez appends .npz when missing
     actual_tmp = tmp if tmp.endswith(".npz") else tmp + ".npz"
@@ -59,12 +72,13 @@ def render_fingerprint(*, chunk: int, spp: int, total: int, scene) -> str:
 
 
 def load_checkpoint(path: str, fingerprint: str = ""):
-    """-> (FilmState, next_chunk, rays_so_far). Raises ValueError when the
-    checkpoint was written under a different render configuration."""
+    """-> (FilmState, next_chunk, rays_so_far, counters). Raises
+    ValueError when the checkpoint was written under a different render
+    configuration. counters is {} for v2 files (pre-telemetry)."""
     import jax.numpy as jnp
 
     with np.load(path) as z:
-        if int(z["version"]) != _FORMAT_VERSION:
+        if int(z["version"]) not in _COMPAT_VERSIONS:
             raise ValueError(f"checkpoint {path}: unsupported version {z['version']}")
         saved_fp = str(z["fingerprint"].item()) if "fingerprint" in z else ""
         # an empty saved fingerprint (hand-written or pre-metadata file)
@@ -75,6 +89,14 @@ def load_checkpoint(path: str, fingerprint: str = ""):
                 f"configuration (saved {saved_fp!r}, current {fingerprint!r}); "
                 "delete it or restore the original settings to resume"
             )
+        counters: Dict[str, Any] = {}
+        if "counters" in z:
+            try:
+                counters = json.loads(str(z["counters"].item())) or {}
+            except ValueError:
+                # a mangled snapshot must not block the film resume —
+                # the counters are telemetry, the film is the render
+                counters = {}
         # jnp.array(copy=True): the render loop DONATES the film state
         # into its jitted chunk dispatch, so the device arrays must own
         # their buffers — a zero-copy alias of the numpy arrays here
@@ -85,4 +107,4 @@ def load_checkpoint(path: str, fingerprint: str = ""):
             weight=jnp.array(z["weight"], copy=True),
             splat=jnp.array(z["splat"], copy=True),
         )
-        return state, int(z["next_chunk"]), int(z["rays"])
+        return state, int(z["next_chunk"]), int(z["rays"]), counters

@@ -126,6 +126,26 @@ def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     return Mesh(np.array(devs[:n]), (TILE_AXIS,))
 
 
+def device_spread(value, n_dev: int, axis: str = TILE_AXIS):
+    """One-hot scatter of a per-device scalar into an (n_dev,) vector:
+    device i contributes `value` at slot i, zeros elsewhere, so the
+    drain's EXISTING aux psum reconstructs the full per-device vector on
+    every device — an all_gather's result without adding a collective
+    (sharded_pool_renderer's no-new-collectives contract and the
+    shardcheck SC-LOOP-COLLECTIVE analysis both stay untouched).
+
+    This is how the ROADMAP multi-chip metric — the per-device
+    wave-count spread of the independent pool drains — leaves the mesh
+    step (obs/counters.spread_stats turns the vector into min/max/
+    rel_spread on the host). Call only inside a shard_map body."""
+    import jax.numpy as jnp
+
+    i = jax.lax.axis_index(axis)
+    return jnp.zeros((n_dev,), jnp.int32).at[i].set(
+        jnp.asarray(value, jnp.int32)
+    )
+
+
 def sharded_chunk_renderer(mesh: Mesh, per_device_fn):
     """Wrap a per-device chunk body into an SPMD step with film all-reduce.
 
