@@ -40,6 +40,7 @@ import os
 import subprocess
 import sys
 import time
+import zlib
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -74,31 +75,86 @@ def _flight_heartbeat(phase: str, **fields):
         pass
 
 
-def probe_backend(timeout_s: float = 150.0) -> tuple[bool, str, int, float]:
+def _probe_hang_attempts() -> set:
+    """Chaos seam for the probe, parsed IMPORT-FREE: `probe:hang@attempt=N`
+    entries of TPU_PBRT_FAULTS name the probe attempts that must simulate
+    the r4/r5-class runtime hang. This mirrors tpu_pbrt/chaos's grammar
+    for the one site that runs before tpu_pbrt may be imported (the real
+    registry lives behind the jax import this path must avoid)."""
+    out = set()
+    for entry in os.environ.get("TPU_PBRT_FAULTS", "").split(","):
+        entry = entry.strip()
+        if not entry.startswith("probe:hang"):
+            continue
+        attempt = 1
+        _, _, tail = entry.partition("@")
+        for part in tail.split("&"):
+            part = part.strip()
+            if not part:
+                continue
+            k, eq, v = part.partition("=")
+            if not eq:
+                k, v = "attempt", k  # bare value -> the site default key
+            if k == "attempt":
+                try:
+                    attempt = int(v)
+                except ValueError:
+                    pass
+        out.add(attempt)
+    return out
+
+
+#: cumulative backoff the probe slept (reported on the outage JSON line)
+_PROBE_BACKOFF_S = 0.0
+
+
+def probe_backend(
+    timeout_s: float = 150.0, max_attempts: int = 0,
+    backoff_base_s: float = 5.0, backoff_cap_s: float = 60.0,
+) -> tuple[bool, str, int, float]:
     """Bounded accelerator-backend health check in a SUBPROCESS (an
     in-process jax.devices() can hang indefinitely when the TPU tunnel
     is down — the r4 capture outage — and nothing in-process can bound
     it; this function must therefore import NOTHING that imports jax).
     Returns (ok, detail, retries, wait_seconds): retries = probe
     attempts beyond the first, wait_seconds = total time burned in the
-    probe incl. cooldowns — BENCH_r05 lost exactly this context (the
-    60 s retry loop only printed to stderr). One retry after a cooldown:
-    transient tunnel resets recover; a real outage is then classified
-    distinctly so the judged line says 'infra outage', not 'tracer
-    broke'."""
-    code = (
+    probe incl. backoff — BENCH_r05 lost exactly this context (the old
+    fixed 60 s retry loop only printed to stderr).
+
+    Retry policy (ISSUE 5 satellite): capped exponential backoff with
+    deterministic jitter between attempts (min(base * 2^k, cap) scaled
+    into [0.5, 1.0]) replaces the fixed 60 s sleep; every attempt and
+    every backoff is heartbeat into the flight recorder with its detail
+    and the cumulative backoff, and an attempt is skipped rather than
+    started when the remaining BENCH budget cannot absorb it. Transient
+    tunnel resets recover; a real outage is then classified distinctly
+    so the judged line says 'infra outage', not 'tracer broke'."""
+    global _PROBE_BACKOFF_S
+    code_ok = (
         "import jax; d = jax.devices(); "
         "print(d[0].platform, len(d), flush=True)"
     )
+    # chaos probe:hang — a subprocess that outlives the timeout is
+    # indistinguishable from the real hung-runtime import
+    code_hang = "import time; time.sleep(3600)"
+    hang_attempts = _probe_hang_attempts()
+    max_attempts = max_attempts or int(
+        os.environ.get("BENCH_PROBE_ATTEMPTS", "3")
+    )
     t_probe = time.time()
     retries = 0
-    for attempt in (1, 2):
+    detail = "?"
+    for attempt in range(1, max_attempts + 1):
         if attempt > 1:
             retries += 1
-        _flight_heartbeat("probe", attempt=attempt)
+        simulated = attempt in hang_attempts
+        _flight_heartbeat(
+            "probe", attempt=attempt,
+            **({"chaos_hang": True} if simulated else {}),
+        )
         try:
             r = subprocess.run(
-                [sys.executable, "-c", code],
+                [sys.executable, "-c", code_hang if simulated else code_ok],
                 capture_output=True, text=True, timeout=timeout_s,
             )
             if r.returncode == 0 and r.stdout.strip():
@@ -111,10 +167,32 @@ def probe_backend(timeout_s: float = 150.0) -> tuple[bool, str, int, float]:
         except subprocess.TimeoutExpired:
             detail = f"backend init hung >{timeout_s:.0f}s"
         _flight_heartbeat("probe", attempt=attempt, ok=False, detail=detail)
-        if attempt == 1 and BUDGET - (time.time() - T_START) > timeout_s + 90:
-            print(f"backend probe failed ({detail}); retrying in 60s",
-                  file=sys.stderr)
-            time.sleep(60)
+        if attempt == max_attempts:
+            break
+        b = min(backoff_base_s * (2.0 ** (attempt - 1)), backoff_cap_s)
+        # deterministic jitter (zlib.crc32 of the attempt index): the
+        # same run shape replays identically under chaos
+        frac = (zlib.crc32(f"probe:{attempt}".encode()) & 0xFFFF) / 65535.0
+        sleep_s = b * (0.5 + 0.5 * frac)
+        if BUDGET - (time.time() - T_START) < timeout_s + sleep_s + 30:
+            # no budget for another attempt + its backoff: stop probing
+            # and let the outage line report what we know
+            _flight_heartbeat(
+                "probe_giveup", attempt=attempt,
+                remaining_s=round(BUDGET - (time.time() - T_START), 1),
+            )
+            break
+        _PROBE_BACKOFF_S += sleep_s
+        _flight_heartbeat(
+            "probe_backoff", attempt=attempt,
+            backoff_s=round(sleep_s, 1),
+            backoff_total_s=round(_PROBE_BACKOFF_S, 1),
+        )
+        print(
+            f"backend probe failed ({detail}); retrying in {sleep_s:.1f}s",
+            file=sys.stderr,
+        )
+        time.sleep(sleep_s)
     return False, detail, retries, time.time() - t_probe
 
 def static_wave_cost(res: int, spp: int, timeout_s: float = 150.0) -> dict:
@@ -250,6 +328,7 @@ def main():
                 # last heartbeat — the diagnosis BENCH_r05 lacked
                 "probe_retries": retries,
                 "probe_wait_seconds": round(wait_s, 1),
+                "probe_backoff_seconds": round(_PROBE_BACKOFF_S, 1),
                 "flight_phase": _last_phase,
                 "flight_path": _FLIGHT_PATH,
             }

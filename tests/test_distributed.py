@@ -51,14 +51,19 @@ class TestFaultInjection:
     """Worker-failure handling (SURVEY.md §2e): dropped chunk dispatches
     are re-dispatched; a state-poisoning failure rolls back to the last
     checkpoint. Both recoveries must be BIT-identical to the undisturbed
-    render (chunks are idempotent pure functions of the work range)."""
+    render (chunks are idempotent pure functions of the work range).
+
+    ISSUE 5 migrated the injections from the old per-integrator
+    `_fault_hook` monkeypatch onto the first-class chaos registry
+    (tpu_pbrt/chaos) — the same seam `python -m tpu_pbrt.chaos`
+    exercises matrix-wide."""
 
     def _scene(self):
         api = make_cornell(res=16, spp=8, integrator="path", maxdepth=2)
         return compile_api(api)
 
     def test_redispatch_bit_identical(self):
-        from tpu_pbrt.integrators.common import ChunkDispatchError
+        from tpu_pbrt.chaos import CHAOS
 
         scene, integ = self._scene()
         # small chunks so the render has several dispatches
@@ -67,34 +72,32 @@ class TestFaultInjection:
         from tpu_pbrt import config
 
         os.environ["TPU_PBRT_CHUNK"] = str(16 * 16 * 2)
+        os.environ["TPU_PBRT_RETRY_BACKOFF"] = "0.01"
         config.reload()
         try:
             ref = integ.render(scene)
 
             scene2, integ2 = self._scene()
-            failures = []
-
-            def hook(c, attempt):
-                if c == 1 and attempt == 0:
-                    failures.append(c)
-                    raise ChunkDispatchError("injected worker loss")
-
-            integ2._fault_hook = hook
+            CHAOS.install("dispatch:fail@chunk=1&attempt=0")
             r = integ2.render(scene2)
+            assert CHAOS.fired_total() == 1, "fault never fired"
+            assert r.stats["recovery"]["redispatches"] == 1
         finally:
+            CHAOS.clear()
             del os.environ["TPU_PBRT_CHUNK"]
-        assert failures == [1], "fault hook never fired"
+            del os.environ["TPU_PBRT_RETRY_BACKOFF"]
         np.testing.assert_array_equal(np.asarray(r.image), np.asarray(ref.image))
         assert r.rays_traced == ref.rays_traced
 
     def test_poisoned_state_recovers_via_checkpoint(self, tmp_path):
-        from tpu_pbrt.integrators.common import ChunkDispatchError
+        from tpu_pbrt.chaos import CHAOS
 
         import os
 
         from tpu_pbrt import config
 
         os.environ["TPU_PBRT_CHUNK"] = str(16 * 16 * 2)
+        os.environ["TPU_PBRT_RETRY_BACKOFF"] = "0.01"
         config.reload()
         try:
             scene, integ = self._scene()
@@ -102,20 +105,14 @@ class TestFaultInjection:
 
             scene2, integ2 = self._scene()
             ck = str(tmp_path / "film.ckpt")
-            fired = []
-
-            def hook(c, attempt):
-                if c == 3 and not fired:
-                    fired.append(c)
-                    raise ChunkDispatchError(
-                        "injected mid-dispatch device loss", poisons_state=True
-                    )
-
-            integ2._fault_hook = hook
+            CHAOS.install("dispatch:poison@chunk=3")
             r = integ2.render(scene2, checkpoint_path=ck, checkpoint_every=1)
+            assert CHAOS.fired_total() == 1
+            assert r.stats["recovery"]["rollbacks"] == 1
         finally:
+            CHAOS.clear()
             del os.environ["TPU_PBRT_CHUNK"]
-        assert fired == [3]
+            del os.environ["TPU_PBRT_RETRY_BACKOFF"]
         np.testing.assert_allclose(
             np.asarray(r.image), np.asarray(ref.image), rtol=1e-6, atol=1e-7
         )

@@ -105,8 +105,9 @@ class TestBitIdentity:
         config.reload()
         jx_off = audit.pool_chunk_jaxpr()
         assert len(jx_off.jaxpr.outvars) == 7
-        # 6 counter leaves (5 scalars + occupancy histogram)
-        assert len(jx_on.jaxpr.outvars) == 13
+        # 7 counter leaves (6 scalars incl. nonfinite_deposits +
+        # occupancy histogram)
+        assert len(jx_on.jaxpr.outvars) == 14
         n_on = sum(len(j.eqns) for j in audit.iter_jaxprs(jx_on.jaxpr))
         n_off = sum(len(j.eqns) for j in audit.iter_jaxprs(jx_off.jaxpr))
         assert n_off < n_on

@@ -89,4 +89,11 @@ def test_regen_occupancy_high_on_depth5_diffuse():
 
 def test_regen_respects_opt_out():
     r = _render(1, {"TPU_PBRT_REGEN": "0"})
-    assert r.stats == {}
+    # no pool/regen stats on the fixed-batch path; the non-finite
+    # firewall (ISSUE 5) is the one telemetry entry it does report —
+    # a clean render counts zero scrubbed deposits
+    assert "regen" not in r.stats
+    assert "mean_wave_occupancy" not in r.stats
+    assert r.stats.get("telemetry", {}).get("counters", {}) == {
+        "nonfinite_deposits": 0
+    }

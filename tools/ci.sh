@@ -53,6 +53,15 @@ python -m tpu_pbrt.obs "$SMOKE_DIR/trace.json" \
     --flight "$SMOKE_DIR/flight.jsonl" \
     --require-phases render,render_done,develop --min-spans 3
 
+# chaos recovery matrix (ISSUE 5): every fault scenario — poisoned/clean
+# dispatch loss, torn/crashed/bit-flipped checkpoint writes, corrupt
+# checkpoint resume, NaN wave, retry-budget exhaustion, mesh device
+# loss — must recover to a film BIT-identical to the undisturbed render
+# (the nan-wave-scrub row instead gates the degrade semantics: finite
+# image + nonfinite_deposits>0). Runs on CPU; no accelerator needed.
+echo "== chaos recovery matrix (python -m tpu_pbrt.chaos)"
+python -m tpu_pbrt.chaos
+
 if [[ "${1:-}" == "--fast" ]]; then
     echo "== pytest skipped (--fast)"
     exit 0

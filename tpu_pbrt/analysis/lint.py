@@ -73,8 +73,15 @@ PRAGMA_BUDGET = 5
 #: allowlist is for whole files whose job contradicts a rule.
 ALLOWLIST: Dict[str, Tuple[str, ...]] = {
     # the config module is the one sanctioned environ reader; the
-    # analysis CLI sets XLA_FLAGS for its own audit subprocess
-    "JL-ENV": ("tpu_pbrt/config.py", "tpu_pbrt/analysis/__main__.py"),
+    # analysis CLI sets XLA_FLAGS for its own audit subprocess; the
+    # chaos matrix runner configures backend/device-count env for its
+    # own process BEFORE jax imports (the same pattern) and sandboxes
+    # per-scenario knobs through config.reload()
+    "JL-ENV": (
+        "tpu_pbrt/config.py",
+        "tpu_pbrt/analysis/__main__.py",
+        "tpu_pbrt/chaos/__main__.py",
+    ),
 }
 
 #: modules whose jax.jit calls thread the film/pool state and must donate

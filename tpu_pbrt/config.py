@@ -89,6 +89,12 @@ class Config:
         "telemetry",
         "trace_path",
         "flight_path",
+        "faults",
+        "nonfinite",
+        "retry_max",
+        "retry_backoff",
+        "retry_backoff_cap",
+        "retry_deadline",
     )
 
     def _load(self) -> "Config":
@@ -151,6 +157,37 @@ class Config:
         self.flight_path: Optional[str] = os.environ.get(
             "TPU_PBRT_FLIGHT_PATH"
         ) or None
+        #: declarative fault-injection plan (tpu_pbrt/chaos grammar, e.g.
+        #: "dispatch:poison@chunk=3,ckpt:torn@write=2"); empty = no chaos.
+        #: Installed into the CHAOS registry once at chaos-package import
+        #: (snapshot contract — reload() does not re-install)
+        self.faults: str = os.environ.get("TPU_PBRT_FAULTS", "").strip()
+        #: non-finite film firewall mode: "scrub" (default — NaN/Inf
+        #: deposits zeroed + counted in nonfinite_deposits), "raise"
+        #: (abort the render on the first scrubbed chunk), "retry"
+        #: (treat the chunk as state-poisoned and re-dispatch it exactly;
+        #: raise/retry pay a per-chunk device sync for the check and
+        #: REQUIRE the telemetry counters — render() rejects the
+        #: combination with TPU_PBRT_TELEMETRY=0 rather than silently
+        #: degrading to scrub)
+        nf = os.environ.get("TPU_PBRT_NONFINITE", "").strip().lower()
+        self.nonfinite: str = nf if nf in ("scrub", "raise", "retry") else "scrub"
+        #: re-dispatch attempts per chunk before the render gives up
+        #: (writes an emergency checkpoint first when one is configured)
+        self.retry_max: int = _int("TPU_PBRT_RETRY_MAX", 8)
+        #: exponential re-dispatch backoff: base seconds ...
+        self.retry_backoff: float = _float("TPU_PBRT_RETRY_BACKOFF", 0.25)
+        #: ... and ceiling seconds (attempt k sleeps
+        #: min(base * 2^(k-1), cap) * deterministic-jitter[0.5, 1.0])
+        self.retry_backoff_cap: float = _float(
+            "TPU_PBRT_RETRY_BACKOFF_CAP", 30.0
+        )
+        #: wall-clock seconds spent retrying before giving up regardless
+        #: of the attempt budget — the BENCH_r04/r05 hang shape, where a
+        #: tight retry loop burned the whole capture (0 disables)
+        self.retry_deadline: float = _float(
+            "TPU_PBRT_RETRY_DEADLINE_S", 600.0
+        )
         return self
 
 

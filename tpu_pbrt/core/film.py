@@ -44,6 +44,20 @@ def merge_film(a: FilmState, b: FilmState) -> FilmState:
     return FilmState(a.rgb + b.rgb, a.weight + b.weight, a.splat + b.splat)
 
 
+def nonfinite_mask(L) -> jnp.ndarray:
+    """Rows of a (..., 3) radiance batch carrying any NaN/Inf component.
+
+    The non-finite FIREWALL's shared predicate (ISSUE 5): every deposit
+    path zeroes these rows before accumulation (the scrub half — pbrt's
+    AddSample NaN drop, extended to Inf), and callers that carry a
+    telemetry block count the same mask into the `nonfinite_deposits`
+    counter — one predicate, so the scrub and the count can never
+    disagree. One contaminated wave therefore cannot poison the film
+    (NaN + x = NaN would otherwise spread to every later checkpoint),
+    and the contamination is visible instead of silent."""
+    return jnp.any(~jnp.isfinite(jnp.asarray(L, jnp.float32)), axis=-1)
+
+
 @partial(jax.jit, static_argnums=(0, 1))
 def _init_state_jit(ry: int, rx: int) -> FilmState:
     return FilmState(
@@ -123,7 +137,7 @@ class Film:
         f = self.filter
         L = jnp.asarray(L, jnp.float32)
         # pbrt: drop NaNs, clamp to maxSampleLuminance
-        bad = jnp.any(jnp.isnan(L) | jnp.isinf(L), axis=-1)
+        bad = nonfinite_mask(L)
         L = jnp.where(bad[..., None], 0.0, L)
         if np.isfinite(self.max_sample_luminance):
             y = luminance(L)
@@ -197,7 +211,7 @@ class Film:
         probability per sample."""
         f = self.filter
         L = jnp.asarray(L, jnp.float32)
-        bad = jnp.any(jnp.isnan(L) | jnp.isinf(L), axis=-1)
+        bad = nonfinite_mask(L)
         L = jnp.where(bad[..., None], 0.0, L)
         if np.isfinite(self.max_sample_luminance):
             y = luminance(L)
@@ -253,7 +267,7 @@ class Film:
         path, so pool and fixed-batch images stay identical).
         Caller must have checked pixel_deposit_ok()."""
         L = jnp.asarray(L, jnp.float32)
-        bad = jnp.any(jnp.isnan(L) | jnp.isinf(L), axis=-1)
+        bad = nonfinite_mask(L)
         L = jnp.where(bad[..., None], 0.0, L)
         if np.isfinite(self.max_sample_luminance):
             y = luminance(L)
@@ -278,7 +292,7 @@ class Film:
     def add_splats(self, state: FilmState, p_film, v) -> FilmState:
         """Film::AddSplat over a batch (no filtering; box deposit)."""
         v = jnp.asarray(v, jnp.float32)
-        bad = jnp.any(jnp.isnan(v) | jnp.isinf(v), axis=-1)
+        bad = nonfinite_mask(v)
         v = jnp.where(bad[..., None], 0.0, v)
         if np.isfinite(self.max_sample_luminance):
             y = luminance(v)

@@ -21,6 +21,7 @@ wavefront equivalent of pbrt's per-pixel sampler streams.
 from __future__ import annotations
 
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
@@ -43,6 +44,7 @@ from tpu_pbrt.core import bxdf
 from tpu_pbrt.core import lights_dev as ld
 from tpu_pbrt.core.film import FilmState
 from tpu_pbrt.parallel.checkpoint import (
+    checkpoint_exists,
     load_checkpoint,
     render_fingerprint,
     save_checkpoint,
@@ -227,6 +229,61 @@ class ChunkDispatchError(RuntimeError):
     def __init__(self, msg="chunk dispatch failed", poisons_state=False):
         super().__init__(msg)
         self.poisons_state = poisons_state
+
+
+class NonFiniteWaveError(ChunkDispatchError):
+    """The non-finite firewall found scrubbed deposits in a chunk under
+    TPU_PBRT_NONFINITE=retry: the accumulated film holds ZEROED
+    contributions where real radiance belonged, so the chunk counts as
+    state-poisoning and recovery re-renders it exactly (rollback or
+    restart + re-dispatch; the chaos nan injection fires once, so the
+    re-run is clean and the final film bit-identical)."""
+
+    def __init__(self, msg):
+        super().__init__(msg, poisons_state=True)
+
+
+class NonFiniteRadianceError(RuntimeError):
+    """TPU_PBRT_NONFINITE=raise: a chunk deposited NaN/Inf radiance (the
+    firewall scrubbed it, but strict mode treats any contamination as a
+    hard error — debugging shaders/scenes where a silent zero would hide
+    the bug)."""
+
+
+def redispatch_backoff(chunk: int, attempt: int) -> float:
+    """Seconds to sleep before re-dispatch `attempt` (1-based) of
+    `chunk`: capped exponential backoff with DETERMINISTIC jitter —
+    min(base * 2^(attempt-1), cap) scaled into [0.5, 1.0] by a hash of
+    (chunk, attempt), so chaos-matrix recoveries are reproducible while
+    real fleet retries still decorrelate across chunks. The tight
+    no-backoff loop this replaces is exactly the BENCH_r04/r05 failure
+    shape: a hung backend ate the whole capture budget in retries."""
+    base = float(cfg.retry_backoff)
+    cap = float(cfg.retry_backoff_cap)
+    if base <= 0.0:
+        return 0.0
+    b = min(base * (2.0 ** max(attempt - 1, 0)), cap)
+    frac = (zlib.crc32(f"{chunk}:{attempt}".encode()) & 0xFFFF) / 65535.0
+    return b * (0.5 + 0.5 * frac)
+
+
+def _fixed_batch_nonfinite(p_film, L):
+    """Non-finite-firewall count for the fixed-batch deposit paths: rows
+    the film is about to scrub, restricted to valid work items (body()
+    parks the final chunk's invalid tail at p_film = -1e6). Returns None
+    when telemetry is killed so the compiled program stays the exact
+    pre-telemetry one."""
+    # direct import (not the module-attr spelling): keeps jaxlint's
+    # by-name call graph from conflating this kill-switch gate with the
+    # unrelated `.enabled` recorder properties
+    from tpu_pbrt.obs.counters import enabled
+
+    if not enabled():
+        return None
+    from tpu_pbrt.core.film import nonfinite_mask
+
+    valid = p_film[..., 0] > -1e5
+    return jnp.sum(nonfinite_mask(L) & valid, dtype=jnp.int32)
 
 
 @dataclass
@@ -776,11 +833,16 @@ class WavefrontIntegrator:
         # the telemetry kill switch changes the traced program (counter
         # carry present/absent), so it is part of the closure identity —
         # a reload() between renders must not reuse the stale closure
+        from tpu_pbrt.chaos import CHAOS
         from tpu_pbrt.obs import counters as _obs_counters
 
+        # chaos nan:wave injection threads a traced wave index into the
+        # single-device pool drain (-1 = clean); its PRESENCE is static
+        # program shape, so it is part of the closure identity
+        chaos_nan = CHAOS.has_nan() and use_regen and mesh is None
         jit_key = (
             scene, mesh, chunk, spp, total, n_dev, pool, use_regen,
-            _obs_counters.enabled(),
+            _obs_counters.enabled(), CHAOS.trace_key(),
         )
         cached = getattr(self, "_jit_cache", None)
         if cached is not None and all(
@@ -789,15 +851,28 @@ class WavefrontIntegrator:
             jfn = cached[1]
         else:
             if use_regen and mesh is None:
+                if chaos_nan:
 
-                def chunk_fn(state: FilmState, dev, start_pix, start_s):
-                    fs2, nrays, live, waves, trunc, ctr = self.pool_chunk(
-                        dev, state, start_pix, start_s, chunk, pool,
-                        film=film, cam=cam,
-                    )
-                    # ctr is None under TPU_PBRT_TELEMETRY=0 — an empty
-                    # pytree leaf, so the killed program is unchanged
-                    return fs2, (nrays, live, waves, trunc, ctr)
+                    def chunk_fn(
+                        state: FilmState, dev, start_pix, start_s, nanw
+                    ):
+                        fs2, nrays, live, waves, trunc, ctr = self.pool_chunk(
+                            dev, state, start_pix, start_s, chunk, pool,
+                            film=film, cam=cam, nan_wave=nanw,
+                        )
+                        return fs2, (nrays, live, waves, trunc, ctr)
+
+                else:
+
+                    def chunk_fn(state: FilmState, dev, start_pix, start_s):
+                        fs2, nrays, live, waves, trunc, ctr = self.pool_chunk(
+                            dev, state, start_pix, start_s, chunk, pool,
+                            film=film, cam=cam,
+                        )
+                        # ctr is None under TPU_PBRT_TELEMETRY=0 — an
+                        # empty pytree leaf, so the killed program is
+                        # unchanged
+                        return fs2, (nrays, live, waves, trunc, ctr)
 
                 jfn = jax.jit(chunk_fn, donate_argnums=(0,))
             elif use_regen:
@@ -840,6 +915,7 @@ class WavefrontIntegrator:
 
                 def chunk_fn(state: FilmState, dev, start_pix, start_s):
                     p_film, L, wt, nrays, splats = body(dev, start_pix, start_s, chunk)
+                    nf = _fixed_batch_nonfinite(p_film, L)
                     if aligned:
                         state = film.add_samples_aligned(
                             state, start_pix, spp, p_film, L, wt
@@ -848,7 +924,7 @@ class WavefrontIntegrator:
                         state = film.add_samples(state, p_film, L, wt)
                     if splats is not None:
                         state = film.add_splats(state, *splats)
-                    return state, nrays
+                    return state, (nrays if nf is None else (nrays, nf))
 
                 jfn = jax.jit(chunk_fn, donate_argnums=(0,))
             else:
@@ -857,18 +933,19 @@ class WavefrontIntegrator:
                 def per_device_fn(dev, start):
                     # start: this device's (1, 2) shard of the (n_dev, 2) pairs
                     p_film, L, wt, nrays, splats = body(dev, start[0, 0], start[0, 1], per_dev)
+                    nf = _fixed_batch_nonfinite(p_film, L)
                     contrib = film.add_samples(film.init_state(), p_film, L, wt)
                     if splats is not None:
                         contrib = film.add_splats(contrib, *splats)
-                    return contrib, nrays
+                    return contrib, (nrays if nf is None else (nrays, nf))
 
                 step = sharded_chunk_renderer(mesh, per_device_fn)
 
                 def chunk_fn(state: FilmState, dev, starts):
-                    contrib, nrays = step(dev, starts)
+                    contrib, aux = step(dev, starts)
                     from tpu_pbrt.core.film import merge_film
 
-                    return merge_film(state, contrib), nrays
+                    return merge_film(state, contrib), aux
 
                 jfn = jax.jit(chunk_fn, donate_argnums=(0,))
             self._jit_cache = (jit_key, jfn)
@@ -906,7 +983,7 @@ class WavefrontIntegrator:
         prev_ctr: Dict[str, Any] = {}
         state = film.init_state()
         fp = render_fingerprint(chunk=chunk, spp=spp, total=total, scene=scene)
-        if ckpt_path and _os.path.exists(ckpt_path):
+        if ckpt_path and checkpoint_exists(ckpt_path):
             state, first_chunk, prev_rays, prev_ctr = load_checkpoint(
                 ckpt_path, fp
             )
@@ -983,16 +1060,57 @@ class WavefrontIntegrator:
         occ_counts = []  # regen mode: (live lane-waves, waves) per chunk
         ctr_counts = []  # telemetry: per-chunk WaveCounters (device side)
         spread_counts = []  # telemetry (mesh): per-device wave vectors
+        nf_counts = []  # fixed-batch firewall: per-chunk scrub counts
+        # host-side recovery accounting (ISSUE 5): flows into the obs
+        # counter dict, the flight recorder and RenderResult.stats
+        recovery = {
+            "redispatches": 0,
+            "rollbacks": 0,
+            "restarts": 0,
+            "nonfinite_retries": 0,
+            "backoff_ms": 0,
+        }
+        # retry extras the INITIAL resume brought in from prior
+        # processes: an in-process rollback later reloads a snapshot
+        # this very loop wrote, so prev_ctr then already bakes in part
+        # of `recovery` — ctr_snapshot must add only the unbaked delta
+        # (prev_ctr[key] - prior_rec[key] is this process's baked share)
+        # or every rollback would double-count the extras it replays
+        prior_rec = {
+            k: int(prev_ctr.get(k, 0))
+            for k in ("chunks_redispatched", "retry_backoff_ms")
+        }
 
         def ctr_snapshot():
             """Cumulative host counter dict (checkpoint payload / final
             stats): the saved snapshot + everything fetched so far. The
             device_get inside to_host is the telemetry's one explicit
             drain-boundary fetch (checkpoint writes are drain
-            boundaries too)."""
-            return obs_counters.merge_host(
+            boundaries too). Folds in the fixed-batch firewall counts
+            and the host-side retry/backoff accounting."""
+            snap = obs_counters.merge_host(
                 prev_ctr, obs_counters.to_host(ctr_counts)
             )
+            if nf_counts:
+                snap = obs_counters.merge_host(
+                    snap,
+                    {
+                        "nonfinite_deposits": sum(
+                            int(v) for v in jax.device_get(nf_counts)
+                        )
+                    },
+                )
+            extra = {}
+            for key, cur in (
+                ("chunks_redispatched", recovery["redispatches"]),
+                ("retry_backoff_ms", recovery["backoff_ms"]),
+            ):
+                # clamp: a rollback that fell back to a PRIOR process's
+                # .prev can hold smaller extras than the initial resume
+                baked = max(0, int(snap.get(key, 0)) - prior_rec[key])
+                if cur > baked:
+                    extra[key] = cur - baked
+            return obs_counters.merge_host(snap, extra)
 
         chunks_done = first_chunk
         FLIGHT.heartbeat(
@@ -1001,9 +1119,39 @@ class WavefrontIntegrator:
         # heartbeat cadence: bounded line count on long renders, but
         # every chunk on short ones so the flight timeline has substance
         hb_every = max(1, n_chunks // 16)
+        # -- recovery policy (ISSUE 5): capped exponential backoff with
+        # deterministic jitter between re-dispatches, an attempt budget
+        # AND a wall-clock deadline (the BENCH_r04/r05 hang shape: a
+        # tight retry loop must not burn the whole capture), and a final
+        # emergency checkpoint before giving up so completed work is
+        # never lost.
+        retry_max = int(cfg.retry_max)
+        retry_deadline = float(cfg.retry_deadline)
+        firewall_mode = cfg.nonfinite  # scrub | raise | retry
+        if firewall_mode != "scrub" and not obs_counters.enabled():
+            # the strict modes read the firewall's scrub COUNT, which
+            # rides the telemetry counters — with them killed the check
+            # would silently degrade to scrub mode, the exact silent
+            # contamination raise/retry exist to prevent
+            raise ValueError(
+                f"TPU_PBRT_NONFINITE={firewall_mode} needs the telemetry "
+                "counters (the firewall's scrub count), but "
+                "TPU_PBRT_TELEMETRY=0 disabled them; re-enable telemetry "
+                "or use the default scrub mode"
+            )
+
+        def chunk_nonfinite(aux):
+            """The per-chunk firewall scrub count (device scalar), or
+            None when telemetry is off (nothing to check)."""
+            if use_regen:
+                ctr = aux[4] if len(aux) > 4 else None
+                return None if ctr is None else ctr.nonfinite
+            return aux[1] if isinstance(aux, tuple) else None
+
         t0 = time.time()
         c = first_chunk
         attempt = 0
+        retry_t0 = None  # wall clock of the current failure streak
         with STATS.phase("Integrator/Render loop"):
             while c < n_chunks:
                 st = starts[c]
@@ -1014,11 +1162,11 @@ class WavefrontIntegrator:
                     # exact. If the failure could have poisoned the
                     # accumulated film (a mid-flight device loss), the
                     # checkpoint (if enabled) rolls the loop back to the
-                    # last durable state instead. `_fault_hook` lets tests
-                    # inject failures deterministically.
-                    hook = getattr(self, "_fault_hook", None)
-                    if hook is not None:
-                        hook(c, attempt)
+                    # last durable state instead. The CHAOS registry
+                    # (tpu_pbrt/chaos) injects deterministic failures
+                    # here — the promoted form of the old test-only
+                    # `_fault_hook` monkeypatch.
+                    CHAOS.dispatch(c, attempt, mesh=mesh is not None)
                     try:
                         # the first dispatch blocks the host on jit
                         # trace+compile; later ones are async enqueues —
@@ -1029,7 +1177,14 @@ class WavefrontIntegrator:
                             if c == first_chunk else "render/chunk_dispatch",
                             chunk=c,
                         ):
-                            if mesh is None:
+                            if mesh is None and chaos_nan:
+                                nanw = jax.device_put(
+                                    np.int32(CHAOS.nan_wave_for(c))
+                                )
+                                state, aux = jfn(
+                                    state, dev, st[0], st[1], nanw
+                                )
+                            elif mesh is None:
                                 state, aux = jfn(state, dev, st[0], st[1])
                             else:
                                 state, aux = jfn(state, dev, st)
@@ -1041,37 +1196,104 @@ class WavefrontIntegrator:
                         raise ChunkDispatchError(
                             f"device dispatch failed: {e}", poisons_state=True
                         ) from e
+                    if firewall_mode != "scrub":
+                        # strict firewall: check THIS chunk's scrub count
+                        # (costs one per-chunk device sync — opt-in).
+                        # raise-mode aborts; retry-mode treats the chunk
+                        # as poisoned (its deposits hold zeroed radiance)
+                        # and re-renders it exactly.
+                        nf_dev = chunk_nonfinite(aux)
+                        nf_ct = (
+                            0 if nf_dev is None
+                            else int(jax.device_get(nf_dev))
+                        )
+                        if nf_ct:
+                            if firewall_mode == "raise":
+                                raise NonFiniteRadianceError(
+                                    f"chunk {c} deposited {nf_ct} non-finite "
+                                    "radiance sample(s) (scrubbed to zero); "
+                                    "TPU_PBRT_NONFINITE=raise treats this "
+                                    "as fatal"
+                                )
+                            recovery["nonfinite_retries"] += 1
+                            raise NonFiniteWaveError(
+                                f"non-finite firewall: chunk {c} scrubbed "
+                                f"{nf_ct} deposit(s)"
+                            )
                 except ChunkDispatchError as e:
                     attempt += 1
+                    recovery["redispatches"] += 1
                     STATS.counter("Distribution/Chunks re-dispatched", 1)
-                    if attempt > 8:
-                        raise RuntimeError(
-                            f"chunk {c} failed {attempt} times"
-                        ) from e
-                    if e.poisons_state and ckpt_path and _os.path.exists(ckpt_path):
+                    now = time.time()
+                    if retry_t0 is None:
+                        retry_t0 = now
+                    deadline_hit = (
+                        retry_deadline > 0
+                        and now - retry_t0 > retry_deadline
+                    )
+                    if attempt > retry_max or deadline_hit:
+                        # unrecoverable: write a final emergency
+                        # checkpoint (unless this very failure poisoned
+                        # the accumulator — then the last durable file
+                        # already holds everything trustworthy) so
+                        # completed work survives the crash
+                        if ckpt_path and not e.poisons_state:
+                            save_checkpoint(
+                                ckpt_path, state, c,
+                                prev_rays + sum(
+                                    int(r)
+                                    for r in jax.device_get(ray_counts)
+                                ),
+                                fingerprint=fp, counters=ctr_snapshot(),
+                            )
+                            FLIGHT.heartbeat(
+                                "render_emergency_checkpoint", chunk=c,
+                                attempt=attempt,
+                            )
+                        reason = (
+                            f"retry deadline ({retry_deadline:.0f}s) exceeded"
+                            if deadline_hit
+                            else f"failed {attempt} times"
+                        )
+                        raise RuntimeError(f"chunk {c} {reason}") from e
+                    if e.poisons_state and ckpt_path and checkpoint_exists(ckpt_path):
                         state, c, prev_rays, prev_ctr = load_checkpoint(
                             ckpt_path, fp
                         )
+                        recovery["rollbacks"] += 1
                         ray_counts.clear()
                         occ_counts.clear()
                         ctr_counts.clear()
                         spread_counts.clear()
+                        nf_counts.clear()
                     elif e.poisons_state:
                         # no durable state to roll back to: restart the render
                         state = film.init_state()
                         c = 0
                         prev_rays = 0
                         prev_ctr = {}
+                        # the prior-process extras restarted with it
+                        prior_rec = {k: 0 for k in prior_rec}
+                        recovery["restarts"] += 1
                         ray_counts.clear()
                         occ_counts.clear()
                         ctr_counts.clear()
                         spread_counts.clear()
+                        nf_counts.clear()
+                    backoff_s = redispatch_backoff(c, attempt)
+                    recovery["backoff_ms"] += int(backoff_s * 1000)
                     FLIGHT.heartbeat(
                         "render_redispatch", chunk=c, attempt=attempt,
-                        poisoned=e.poisons_state, error=str(e)[:200],
+                        poisoned=e.poisons_state,
+                        backoff_s=round(backoff_s, 3),
+                        backoff_total_ms=recovery["backoff_ms"],
+                        error=str(e)[:200],
                     )
+                    if backoff_s > 0:
+                        time.sleep(backoff_s)
                     continue
                 attempt = 0
+                retry_t0 = None
                 c += 1
                 if use_regen:
                     nrays, lv, wv, trunc = aux[:4]
@@ -1080,6 +1302,9 @@ class WavefrontIntegrator:
                         ctr_counts.append(aux[4])
                     if len(aux) > 5 and aux[5] is not None:
                         spread_counts.append(aux[5])
+                elif isinstance(aux, tuple):
+                    nrays, nf_dep = aux
+                    nf_counts.append(nf_dep)
                 else:
                     nrays = aux
                 ray_counts.append(nrays)  # defer the sync: keep the pipe full
@@ -1163,6 +1388,10 @@ class WavefrontIntegrator:
 
                     _W(f"could not write image {film.filename}: {e}")
         stats: Dict[str, Any] = {}
+        if any(recovery.values()):
+            # the render survived at least one failure — surface the
+            # full retry/rollback/backoff accounting next to the image
+            stats["recovery"] = dict(recovery)
         if use_regen and occ_counts:
             occ_host = jax.device_get(occ_counts)
             lv_t = sum(int(a) for a, _, _ in occ_host)

@@ -604,7 +604,8 @@ class PathIntegrator(WavefrontIntegrator):
 
     # -- persistent wavefront: compaction + regeneration -------------------
     def pool_chunk(self, dev, fs: FilmState, start_pix, start_s,
-                   n_work: int, pool: int, film=None, cam=None):
+                   n_work: int, pool: int, film=None, cam=None,
+                   nan_wave=None):
         """Drain work items [start, start + n_work) through a resident
         pool of `pool` path slots, one bounce per wave.
 
@@ -629,6 +630,13 @@ class PathIntegrator(WavefrontIntegrator):
         counters is the telemetry WaveCounters block carried through the
         drain (None under TPU_PBRT_TELEMETRY=0 — an empty pytree leaf,
         so the killed program is the exact pre-telemetry one).
+
+        nan_wave is the chaos-injection seam (tpu_pbrt/chaos `nan:wave`):
+        a traced int32 scalar naming the wave whose active lanes get
+        their radiance replaced with NaN (-1 = clean dispatch — the host
+        passes -1 on every re-dispatch after the fault fired, so exact
+        recovery needs no recompile). None (no nan site in the plan)
+        compiles no injection code at all.
         """
         from tpu_pbrt.obs import counters as obs_counters
 
@@ -721,12 +729,29 @@ class PathIntegrator(WavefrontIntegrator):
                 scalar_bounce=None, ctr=ps.ctr,
             )
 
+            if nan_wave is not None:
+                # chaos nan:wave injection — contaminate every resident
+                # lane's radiance on the named wave. The NaNs ride the
+                # lanes to their deposit wave (NaN + x = NaN), where the
+                # film firewall scrubs and counts them
+                poison = has_work & (ps.waves == nan_wave)
+                lane = lane._replace(
+                    L=jnp.where(
+                        poison[..., None], jnp.float32(jnp.nan), lane.L
+                    )
+                )
+
             # ---- scatter-on-terminate film deposit -------------------
             done = has_work & ~lane.alive & ~(lane.sh_dist > 0.0)
             if ctr is not None:
+                from tpu_pbrt.core.film import nonfinite_mask
+
                 # structural drain counters (rays/occupancy were folded
                 # in by _bounce_wave): all pure in-loop i32 reductions,
-                # fetched once at the drain boundary with the rest of aux
+                # fetched once at the drain boundary with the rest of aux.
+                # nonfinite counts the deposits the film firewall is
+                # about to scrub — same predicate the deposit uses, so
+                # the count and the scrub can never disagree
                 ctr = obs_counters.pool_update(
                     ctr,
                     regenerated=jnp.sum(can, dtype=jnp.int32),
@@ -736,6 +761,9 @@ class PathIntegrator(WavefrontIntegrator):
                     deposits=jnp.sum(done, dtype=jnp.int32),
                     compacted=jnp.sum(
                         active & (perm != lane_idx), dtype=jnp.int32
+                    ),
+                    nonfinite=jnp.sum(
+                        done & nonfinite_mask(lane.L), dtype=jnp.int32
                     ),
                 )
             if box_fast:

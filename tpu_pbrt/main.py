@@ -51,6 +51,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "phases (also settable via TPU_PBRT_TRACE_PATH); view at "
         "ui.perfetto.dev",
     )
+    p.add_argument(
+        "--faults",
+        default="",
+        metavar="PLAN",
+        help="chaos fault-injection plan (tpu_pbrt.chaos grammar, e.g. "
+        "'dispatch:poison@chunk=3,ckpt:torn@write=2'); also settable via "
+        "TPU_PBRT_FAULTS — see `python -m tpu_pbrt.chaos --list`",
+    )
     return p
 
 
@@ -74,6 +82,10 @@ def main(argv=None) -> int:
 
     if args.trace:
         TRACE.configure(args.trace)
+    if args.faults:
+        from tpu_pbrt.chaos import CHAOS
+
+        CHAOS.install(args.faults)
     maybe_init_distributed(opts)
     try:
         for scene in args.scenes:

@@ -31,6 +31,7 @@ HOST_FIELDS = (
     "lanes_terminated",
     "film_deposits",
     "lanes_compacted",
+    "nonfinite_deposits",
     "occupancy_histogram",
 )
 
@@ -49,6 +50,10 @@ class WaveCounters(NamedTuple):
     deposits: jnp.ndarray
     #: live lanes relocated by the compaction sort (slot index changed)
     compacted: jnp.ndarray
+    #: deposits whose radiance carried NaN/Inf and was scrubbed to zero
+    #: by the film's non-finite firewall (ISSUE 5: one bad wave must not
+    #: silently poison every later checkpoint — > 0 here is the signal)
+    nonfinite: jnp.ndarray
     #: per-wave occupancy histogram (live lanes / pool width at trace time)
     occ_hist: jnp.ndarray
 
@@ -69,6 +74,7 @@ def zeros() -> WaveCounters:
         terminated=z,
         deposits=z,
         compacted=z,
+        nonfinite=z,
         occ_hist=jnp.zeros((N_OCC_BINS,), jnp.int32),
     )
 
@@ -100,18 +106,22 @@ def bounce_update(
 
 def pool_update(
     ctr: Optional[WaveCounters], *, regenerated, terminated, deposits,
-    compacted,
+    compacted, nonfinite=None,
 ) -> Optional[WaveCounters]:
     """The drain-loop structural counters, from the `pool_chunk` body:
-    each argument is this wave's int32 count."""
+    each argument is this wave's int32 count. nonfinite is the firewall's
+    scrubbed-deposit count (None keeps the field untouched)."""
     if ctr is None:
         return None
-    return ctr._replace(
+    upd = ctr._replace(
         regenerated=ctr.regenerated + regenerated,
         terminated=ctr.terminated + terminated,
         deposits=ctr.deposits + deposits,
         compacted=ctr.compacted + compacted,
     )
+    if nonfinite is not None:
+        upd = upd._replace(nonfinite=ctr.nonfinite + nonfinite)
+    return upd
 
 
 # -- host side (the one fetch at the drain boundary) -----------------------
@@ -132,6 +142,7 @@ def to_host(ctrs: Iterable[WaveCounters]) -> Dict[str, Any]:
         out["lanes_terminated"] += int(c.terminated)
         out["film_deposits"] += int(c.deposits)
         out["lanes_compacted"] += int(c.compacted)
+        out["nonfinite_deposits"] += int(c.nonfinite)
         hist = [int(v) for v in c.occ_hist]
         out["occupancy_histogram"] = [
             a + b for a, b in zip(out["occupancy_histogram"], hist)

@@ -5,28 +5,113 @@ cheap win): because film accumulation is associative and every chunk is an
 idempotent pure function of (scene, work range), a checkpoint is just the
 accumulated film pytree plus the chunk cursor. The counter-based RNG keyed
 on (pixel, sample, dimension) makes a resumed render bit-identical to an
-uninterrupted one. Written atomically (tmp + rename) so a crash mid-write
-leaves the previous checkpoint intact.
+uninterrupted one.
 
-Format v3 adds the cumulative telemetry-counter snapshot (obs/counters
-host dict, JSON-encoded) so a resumed render reports END-TO-END totals —
-rays/regenerations/deposits across every process that touched the film,
-not just the last one. v2 files (no counter field) still load, with an
-empty snapshot."""
+Durability (ISSUE 5 hardening). A write is tmp + fsync(tmp) + fsync(dir)
++ rename: without the fsyncs, a crash AFTER the rename could still leave
+a zero-length "durable" checkpoint (the rename is atomic in the namespace
+but the data may not have reached the platter). Format v4 adds a CRC32
+content checksum over the film arrays + metadata, and every write rotates
+the previous good file to `<path>.prev` — `load_checkpoint` detects a
+corrupt/torn current file (checksum mismatch, truncated zip, short read)
+and falls back to `.prev` instead of crashing the resume. Corruption is
+distinct from misconfiguration: a version/fingerprint mismatch still
+raises immediately (falling back would silently resume the wrong render).
+
+Format history: v2 = film + cursor + fingerprint; v3 added the cumulative
+telemetry-counter snapshot (obs/counters host dict, JSON-encoded) so a
+resumed render reports END-TO-END totals; v4 added the content checksum.
+v2/v3 files still load (no checksum to verify, empty counters for v2).
+
+Chaos seams (tpu_pbrt/chaos): `ckpt:torn|crash|bitflip@write=N` faults
+are applied here — a torn final file, a simulated crash between the tmp
+write and the rename, and a seeded bit-flip — so the recovery path above
+is continuously testable on CPU.
+"""
 
 from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import Any, Dict, Optional
 
 import numpy as np
 
+from tpu_pbrt.chaos import CHAOS
 from tpu_pbrt.core.film import FilmState
 
-_FORMAT_VERSION = 3
+_FORMAT_VERSION = 4
 #: versions load_checkpoint still understands
-_COMPAT_VERSIONS = (2, 3)
+_COMPAT_VERSIONS = (2, 3, 4)
+
+
+class CorruptCheckpointError(ValueError):
+    """The checkpoint file cannot be trusted (torn/short/bit-flipped —
+    checksum mismatch or unparseable archive). Distinct from the plain
+    ValueError raised for version/fingerprint MISconfiguration:
+    corruption triggers the `.prev` fallback, misconfiguration never
+    does."""
+
+
+def _content_checksum(
+    rgb: np.ndarray, weight: np.ndarray, splat: np.ndarray,
+    next_chunk: int, rays: int, fingerprint: str, counters_json: str,
+) -> int:
+    crc = 0
+    for a in (rgb, weight, splat):
+        crc = zlib.crc32(np.ascontiguousarray(a).tobytes(), crc)
+    meta = f"{int(next_chunk)}|{int(rays)}|{fingerprint}|{counters_json}"
+    return zlib.crc32(meta.encode(), crc) & 0xFFFFFFFF
+
+
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the containing directory so the rename itself is durable;
+    best-effort — some filesystems refuse O_RDONLY on directories and a
+    telemetry-grade durability upgrade must not kill the render."""
+    try:
+        _fsync_path(os.path.dirname(os.path.abspath(path)) or ".")
+    except OSError:
+        pass
+
+
+def _rotate_prev(path: str) -> None:
+    """Rotate the current checkpoint to `<path>.prev` WITHOUT a window
+    where no file exists at `path`: hardlink the current inode to .prev
+    and let the caller's later os.replace atomically swap the new data
+    in. A rename-based rotate would un-publish the current file until
+    the replace lands — a crash in that window leaves a resume that
+    silently restarts from chunk 0 despite a good .prev on disk. Falls
+    back to the rename on filesystems without hardlinks (the
+    checkpoint_exists()/load fallback still recovers there)."""
+    if not os.path.exists(path):
+        return
+    prev = path + ".prev"
+    try:
+        os.remove(prev)
+    except FileNotFoundError:
+        pass
+    try:
+        os.link(path, prev)
+    except OSError:
+        os.replace(path, prev)
+
+
+def checkpoint_exists(path: str) -> bool:
+    """True when `path` OR its `.prev` rotation holds a resumable file.
+    Resume/rollback sites must use this rather than a bare exists(path):
+    after a crash inside a (hardlink-less) rotation, or a deleted
+    current file, load_checkpoint still recovers via .prev — a bare
+    check would silently restart from scratch instead."""
+    return os.path.exists(path) or os.path.exists(path + ".prev")
 
 
 def save_checkpoint(
@@ -42,21 +127,76 @@ def save_checkpoint(
     render_fingerprint); load_checkpoint refuses a mismatch rather than
     silently misinterpreting the cursor (ADVICE r1). counters is the
     cumulative telemetry snapshot (may be None/{} with telemetry killed)."""
+    rgb = np.asarray(state.rgb)
+    weight = np.asarray(state.weight)
+    splat = np.asarray(state.splat)
+    counters_json = json.dumps(counters or {})
+    checksum = _content_checksum(
+        rgb, weight, splat, next_chunk, rays_so_far, fingerprint,
+        counters_json,
+    )
     tmp = path + ".tmp"
     np.savez_compressed(
-        tmp if tmp.endswith(".npz") else tmp,
+        tmp,
         version=_FORMAT_VERSION,
-        rgb=np.asarray(state.rgb),
-        weight=np.asarray(state.weight),
-        splat=np.asarray(state.splat),
+        rgb=rgb,
+        weight=weight,
+        splat=splat,
         next_chunk=next_chunk,
         rays=rays_so_far,
         fingerprint=np.array(fingerprint),
-        counters=np.array(json.dumps(counters or {})),
+        counters=np.array(counters_json),
+        checksum=checksum,
     )
     # np.savez appends .npz when missing
     actual_tmp = tmp if tmp.endswith(".npz") else tmp + ".npz"
+
+    fault = CHAOS.checkpoint_fault()
+    if fault == "bitflip":
+        # seeded single-byte corruption of the payload — the checksum
+        # (or the zip parse) must catch it at load time
+        with open(actual_tmp, "r+b") as f:
+            size = os.path.getsize(actual_tmp)
+            off = CHAOS.bitflip_offset(size)
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+
+    # durability: the data must be on disk BEFORE the rename publishes it
+    # — a crash after rename would otherwise leave a zero-length
+    # "durable" checkpoint (the ISSUE 5 satellite fix)
+    _fsync_path(actual_tmp)
+
+    if fault == "crash":
+        # simulated process death between the tmp write and the rename:
+        # the tmp file is left behind (like a real crash would) and the
+        # previous checkpoint stays the current one
+        return
+
+    if fault == "torn":
+        # simulated torn write: rotate the good previous file, then
+        # publish a TRUNCATED current — load must fall back to .prev.
+        # The truncated bytes go through their own tmp + replace (never
+        # an in-place truncate of `path`: after the hardlink rotation
+        # .prev shares that inode and would be torn too)
+        with open(actual_tmp, "rb") as f:
+            data = f.read()
+        _rotate_prev(path)
+        torn_tmp = actual_tmp + ".torn"
+        with open(torn_tmp, "wb") as f:
+            f.write(data[: max(len(data) // 3, 1)])
+        os.replace(torn_tmp, path)
+        os.remove(actual_tmp)
+        _fsync_dir(path)
+        return
+
+    # rotate: keep the previous good checkpoint as the corruption
+    # fallback (hardlinked — `path` never goes missing), then atomically
+    # publish the new one
+    _rotate_prev(path)
     os.replace(actual_tmp, path)
+    _fsync_dir(path)
 
 
 def render_fingerprint(*, chunk: int, spp: int, total: int, scene) -> str:
@@ -71,40 +211,95 @@ def render_fingerprint(*, chunk: int, spp: int, total: int, scene) -> str:
     )
 
 
+def _load_one(path: str, fingerprint: str = ""):
+    """Load and verify ONE checkpoint file. Raises CorruptCheckpointError
+    for anything that smells like torn/flipped bytes, plain ValueError
+    for version/fingerprint misconfiguration."""
+    import zipfile
+
+    import jax.numpy as jnp
+
+    try:
+        with np.load(path) as z:
+            version = int(z["version"])
+            raw = {
+                k: np.asarray(z[k])
+                for k in ("rgb", "weight", "splat")
+            }
+            next_chunk = int(z["next_chunk"])
+            rays = int(z["rays"])
+            saved_fp = str(z["fingerprint"].item()) if "fingerprint" in z else ""
+            counters_json = (
+                str(z["counters"].item()) if "counters" in z else "{}"
+            )
+            saved_crc = int(z["checksum"]) if "checksum" in z else None
+    except (OSError, EOFError, KeyError, zipfile.BadZipFile, zlib.error) as e:
+        raise CorruptCheckpointError(f"unreadable checkpoint {path}: {e}") from e
+    except ValueError as e:
+        # np internals raise ValueError on mangled headers/arrays
+        raise CorruptCheckpointError(f"unparseable checkpoint {path}: {e}") from e
+
+    if version not in _COMPAT_VERSIONS:
+        raise ValueError(f"checkpoint {path}: unsupported version {version}")
+    # an empty saved fingerprint (hand-written or pre-metadata file)
+    # is accepted; only a conflicting one is an error
+    if fingerprint and saved_fp and saved_fp != fingerprint:
+        raise ValueError(
+            f"checkpoint {path} was written for a different render "
+            f"configuration (saved {saved_fp!r}, current {fingerprint!r}); "
+            "delete it or restore the original settings to resume"
+        )
+    if saved_crc is not None:
+        crc = _content_checksum(
+            raw["rgb"], raw["weight"], raw["splat"], next_chunk, rays,
+            saved_fp, counters_json,
+        )
+        if crc != saved_crc:
+            raise CorruptCheckpointError(
+                f"checkpoint {path}: content checksum mismatch "
+                f"(saved {saved_crc:#010x}, computed {crc:#010x}) — "
+                "torn or bit-flipped write"
+            )
+    counters: Dict[str, Any] = {}
+    try:
+        counters = json.loads(counters_json) or {}
+    except ValueError:
+        # a mangled snapshot must not block the film resume —
+        # the counters are telemetry, the film is the render
+        counters = {}
+    # jnp.array(copy=True): the render loop DONATES the film state
+    # into its jitted chunk dispatch, so the device arrays must own
+    # their buffers — a zero-copy alias of the numpy arrays here
+    # (jax on CPU aliases host memory) gets freed/overwritten by the
+    # donation and corrupts the heap (flaky resume-test aborts)
+    state = FilmState(
+        rgb=jnp.array(raw["rgb"], copy=True),
+        weight=jnp.array(raw["weight"], copy=True),
+        splat=jnp.array(raw["splat"], copy=True),
+    )
+    return state, next_chunk, rays, counters
+
+
 def load_checkpoint(path: str, fingerprint: str = ""):
     """-> (FilmState, next_chunk, rays_so_far, counters). Raises
     ValueError when the checkpoint was written under a different render
-    configuration. counters is {} for v2 files (pre-telemetry)."""
-    import jax.numpy as jnp
+    configuration. counters is {} for v2 files (pre-telemetry).
 
-    with np.load(path) as z:
-        if int(z["version"]) not in _COMPAT_VERSIONS:
-            raise ValueError(f"checkpoint {path}: unsupported version {z['version']}")
-        saved_fp = str(z["fingerprint"].item()) if "fingerprint" in z else ""
-        # an empty saved fingerprint (hand-written or pre-metadata file)
-        # is accepted; only a conflicting one is an error
-        if fingerprint and saved_fp and saved_fp != fingerprint:
-            raise ValueError(
-                f"checkpoint {path} was written for a different render "
-                f"configuration (saved {saved_fp!r}, current {fingerprint!r}); "
-                "delete it or restore the original settings to resume"
+    A corrupt/torn CURRENT file falls back to the rotated `<path>.prev`
+    (the previous good write) instead of crashing the resume; only when
+    both are unusable does the corruption propagate."""
+    try:
+        return _load_one(path, fingerprint)
+    except CorruptCheckpointError as e:
+        prev = path + ".prev"
+        if os.path.exists(prev):
+            from tpu_pbrt.utils.error import Warning as _W
+
+            _W(
+                f"checkpoint {path} is corrupt ({e}); falling back to the "
+                f"previous good checkpoint {prev}"
             )
-        counters: Dict[str, Any] = {}
-        if "counters" in z:
-            try:
-                counters = json.loads(str(z["counters"].item())) or {}
-            except ValueError:
-                # a mangled snapshot must not block the film resume —
-                # the counters are telemetry, the film is the render
-                counters = {}
-        # jnp.array(copy=True): the render loop DONATES the film state
-        # into its jitted chunk dispatch, so the device arrays must own
-        # their buffers — a zero-copy alias of the numpy arrays here
-        # (jax on CPU aliases host memory) gets freed/overwritten by the
-        # donation and corrupts the heap (flaky resume-test aborts)
-        state = FilmState(
-            rgb=jnp.array(z["rgb"], copy=True),
-            weight=jnp.array(z["weight"], copy=True),
-            splat=jnp.array(z["splat"], copy=True),
-        )
-        return state, int(z["next_chunk"]), int(z["rays"]), counters
+            return _load_one(prev, fingerprint)
+        raise CorruptCheckpointError(
+            f"checkpoint {path} is corrupt and no {prev} fallback exists: {e}"
+        ) from e

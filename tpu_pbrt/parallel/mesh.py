@@ -149,11 +149,23 @@ def device_spread(value, n_dev: int, axis: str = TILE_AXIS):
 def sharded_chunk_renderer(mesh: Mesh, per_device_fn):
     """Wrap a per-device chunk body into an SPMD step with film all-reduce.
 
-    per_device_fn(dev, start_scalar) -> (film_contrib pytree, nrays scalar):
-    the film contribution of that device's work-items. The wrapped function
-    takes (dev, starts (n_dev,)) with starts sharded over the mesh and
-    returns the psum-merged (film_contrib, nrays), replicated — ready to add
-    into the accumulated film state."""
+    per_device_fn(dev, start_scalar) -> (film_contrib pytree, aux pytree):
+    the film contribution of that device's work-items plus scalar
+    accounting (nrays, and the firewall's non-finite scrub count when
+    telemetry is on). The wrapped function takes (dev, starts (n_dev,))
+    with starts sharded over the mesh and returns the psum-merged
+    (film_contrib, aux), replicated — ready to add into the accumulated
+    film state.
+
+    Failure model (ISSUE 5): there is no per-device recovery INSIDE the
+    SPMD step — a lost device fails the whole dispatch (the host sees a
+    JaxRuntimeError), and the render loop's recovery ladder handles it
+    as a state-poisoning chunk failure: rollback to the last durable
+    checkpoint (or restart) + capped-backoff re-dispatch. Chunks are
+    idempotent, so the re-run on the surviving mesh is exact. The chaos
+    plan's `mesh:lost@chunk=N` injects exactly this shape on the CPU
+    mesh; true degraded-mesh continuation (re-forming a smaller mesh
+    without a restart) is a ROADMAP open item pending live hardware."""
 
     @partial(
         shard_map,
@@ -163,10 +175,10 @@ def sharded_chunk_renderer(mesh: Mesh, per_device_fn):
         **SHARD_MAP_NOCHECK,
     )
     def step(dev, starts):
-        contrib, nrays = per_device_fn(dev, starts)
+        contrib, aux = per_device_fn(dev, starts)
         contrib = jax.tree.map(lambda x: jax.lax.psum(x, TILE_AXIS), contrib)
-        nrays = jax.lax.psum(nrays, TILE_AXIS)
-        return contrib, nrays
+        aux = jax.tree.map(lambda x: jax.lax.psum(x, TILE_AXIS), aux)
+        return contrib, aux
 
     return step
 
