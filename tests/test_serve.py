@@ -1,0 +1,345 @@
+"""tpu-serve (ISSUE 6): multi-tenant render service.
+
+Oracles:
+
+- BIT-IDENTITY UNDER MULTIPLEXING: chunks are idempotent pure functions
+  of (scene, work range) and film accumulation is associative, so a
+  job's film must be bit-identical to its solo run-to-completion render
+  no matter how its slices interleave with other tenants', and across a
+  preempt(emergency checkpoint)/resume cycle — at spp=1 every pixel
+  holds one sample, so there is no accumulation-order freedom at all.
+- RESIDENCY: a repeat submit of a warm scene pays 0 scene compiles and
+  0 jit retraces (the PR 2 `_cache_size` audit applied to serving);
+  cancel releases the pin; the LRU evicts by HBM footprint and never
+  evicts pinned entries.
+- POLICY: scheduling is deterministic given a seed (same submit
+  sequence -> same schedule), weighted-fair across tenants, and strict
+  across priority classes (with film-state preemption under
+  max_active).
+"""
+
+import numpy as np
+import pytest
+
+from tpu_pbrt.scene.api import Options, compile_string
+from tpu_pbrt.scenes import cornell_box_text
+from tpu_pbrt.serve import (
+    FairScheduler,
+    RenderService,
+    ResidencyCache,
+    preemption_victim,
+    scene_hbm_bytes,
+)
+
+SPP = 1  # one sample per pixel: bit-identity has no order freedom
+TEXT = cornell_box_text(res=32, spp=SPP, integrator="path", maxdepth=3)
+CHUNK = 256  # 32*32*1 = 1024 work items -> 4 slices per job
+
+
+@pytest.fixture(scope="module")
+def solo_ref():
+    """Solo run-to-completion reference (its own compile + integrator,
+    rendered through the monolithic loop — the service must reproduce
+    these bits through sliced, interleaved, preempted scheduling)."""
+    scene, integ = compile_string(TEXT, Options(quiet=True))
+    return np.asarray(integ.render(scene).image, np.float32)
+
+
+# --------------------------------------------------------------------------
+# queue policy (pure host units)
+# --------------------------------------------------------------------------
+
+
+class _J:
+    def __init__(self, seq, tenant="t", priority=0):
+        self.seq = seq
+        self.tenant = tenant
+        self.priority = priority
+
+
+def test_scheduler_weighted_fair_and_deterministic():
+    def run(seed):
+        s = FairScheduler(seed=seed)
+        s.set_weight("heavy", 2.0)
+        s.set_weight("light", 1.0)
+        jobs = [_J(1, "heavy"), _J(2, "light")]
+        order = []
+        for _ in range(30):
+            j = s.pick(jobs)
+            order.append(j.tenant)
+            s.charge(j.tenant)
+        return order
+
+    a, b = run(7), run(7)
+    assert a == b, "same seed must reproduce the schedule"
+    # weight 2 tenant gets ~2x the slices under contention
+    assert 18 <= a.count("heavy") <= 22, a.count("heavy")
+
+
+def test_scheduler_reenter_drops_banked_credit():
+    """A tenant that went idle while others kept dispatching must
+    re-enter at the busy tenants' vtime floor, not replay its stale low
+    vtime and monopolize the mesh."""
+    s = FairScheduler(seed=0)
+    s.tenant("a")
+    s.tenant("b")
+    for _ in range(100):
+        s.charge("a")  # b idles while a spends 100 slices
+    s.reenter("b", busy_tenants={"a"})
+    assert s.tenant("b").vtime == s.tenant("a").vtime
+    # and with nobody busy, re-entry is a no-op
+    s.reenter("b", busy_tenants=set())
+    assert s.tenant("b").vtime == s.tenant("a").vtime
+
+
+def test_scheduler_priority_classes_beat_fairness():
+    s = FairScheduler(seed=0)
+    low, high = _J(1, "a", priority=0), _J(2, "b", priority=5)
+    for _ in range(5):
+        assert s.pick([low, high]) is high
+        s.charge("b")
+
+
+def test_preemption_victim_picks_lowest_outranked():
+    a = _J(1, priority=0)
+    b = _J(2, priority=2)
+    cand = _J(3, priority=5)
+    assert preemption_victim([a, b], cand) is a
+    assert preemption_victim([b], _J(4, priority=2)) is None  # ties don't preempt
+
+
+# --------------------------------------------------------------------------
+# residency (host units over fake scenes)
+# --------------------------------------------------------------------------
+
+
+class _FakeFilm:
+    full_resolution = (4, 4)
+
+
+class _FakeScene:
+    def __init__(self, kb):
+        self.dev = {"a": np.zeros(kb * 256, np.float32)}  # kb KiB
+        self.film = _FakeFilm()
+
+
+def test_residency_lru_eviction_respects_pins():
+    base = scene_hbm_bytes(_FakeScene(0))
+    cache = ResidencyCache(max_bytes=2 * (base + 100 * 1024) + 1024)
+    for key, kb in (("s1", 100), ("s2", 100), ("s3", 100)):
+        cache.get_or_compile(key, lambda kb=kb: (_FakeScene(kb), object()))
+    # LRU (s1) evicted to fit the budget
+    assert cache.get("s1") is None
+    assert cache.get("s2") is not None and cache.get("s3") is not None
+    assert cache.evictions == 1 and cache.scene_compiles == 3
+    # a pinned entry survives even when it is the LRU victim
+    cache.pin("s2")
+    _ = cache.get("s3")  # make s2 the coldest
+    cache.get_or_compile("s4", lambda: (_FakeScene(100), object()))
+    assert cache.get("s2") is not None, "pinned entry was evicted"
+    # hits don't recompile
+    n = cache.scene_compiles
+    cache.get_or_compile("s4", lambda: (_FakeScene(100), object()))
+    assert cache.scene_compiles == n and cache.hits == 1
+
+
+# --------------------------------------------------------------------------
+# the service (real renders, single device)
+# --------------------------------------------------------------------------
+
+
+def _service(**kw):
+    kw.setdefault("chunk", CHUNK)
+    kw.setdefault("seed", 0)
+    return RenderService(**kw)
+
+
+def test_interleaved_jobs_bit_identical_to_solo(solo_ref):
+    svc = _service()
+    j1 = svc.submit(text=TEXT, tenant="alice")
+    j2 = svc.submit(text=TEXT, tenant="bob")
+    assert svc.residency.stats()["scene_compiles"] == 1, (
+        "two same-scene submits must share one resident compile"
+    )
+    svc.drain()
+    # the fair scheduler actually interleaved the two jobs' slices
+    owners = [jid for jid, _ in svc.schedule]
+    first_done = {jid: owners[::-1].index(jid) for jid in (j1, j2)}
+    assert owners.index(j2) < len(owners) - 1 - first_done[j1], (
+        f"schedule never interleaved: {svc.schedule}"
+    )
+    for j in (j1, j2):
+        img = np.asarray(svc.result(j).image, np.float32)
+        assert np.isfinite(img).all()
+        assert np.array_equal(img, solo_ref), (
+            f"{j} differs from solo (max "
+            f"{np.max(np.abs(img - solo_ref))})"
+        )
+
+
+def test_preempt_resume_bit_identical(solo_ref):
+    svc = _service()
+    j = svc.submit(text=TEXT)
+    svc.step()
+    svc.step()
+    svc.preempt(j)  # emergency checkpoint + film state dropped
+    job = svc.jobs[j]
+    assert job.state is None and job.status == "paused"
+    assert svc.step() is None, "paused job must not schedule"
+    svc.resume(j)
+    svc.drain()
+    assert job.preemptions == 1
+    img = np.asarray(svc.result(j).image, np.float32)
+    assert np.array_equal(img, solo_ref)
+
+
+def test_warm_resubmit_zero_scene_and_jit_recompiles(solo_ref):
+    svc = _service()
+    j1 = svc.submit(text=TEXT)
+    svc.drain()
+    ent = svc.residency.get(svc.jobs[j1].resident_key)
+    jfn = ent.integrator._jit_cache[1]
+    size = jfn._cache_size()
+    j2 = svc.submit(text=TEXT)
+    svc.drain()
+    stats = svc.residency.stats()
+    assert stats["scene_compiles"] == 1, stats
+    jfn2 = ent.integrator._jit_cache[1]
+    assert jfn2 is jfn, "warm resubmit rebuilt the chunk closure"
+    assert jfn2._cache_size() == size, "warm resubmit retraced"
+    assert np.array_equal(
+        np.asarray(svc.result(j2).image, np.float32), solo_ref
+    )
+
+
+def test_cancel_releases_residency_and_spool():
+    import os
+
+    svc = _service(max_resident_bytes=1)  # budget nothing fits
+    j = svc.submit(text=TEXT)
+    key = svc.jobs[j].resident_key
+    # pinned by the live job: over budget but NOT evictable
+    assert svc.residency.get(key) is not None
+    svc.step()
+    ckpt = svc.jobs[j].checkpoint_path
+    svc.preempt(j)
+    assert os.path.exists(ckpt), "preempt must write the emergency checkpoint"
+    svc.cancel(j)
+    assert svc.jobs[j].status == "cancelled"
+    # unpinned -> the over-budget eviction reclaims the scene, and the
+    # spool checkpoint is gone
+    assert svc.residency.get(key) is None
+    assert not os.path.exists(ckpt)
+
+
+def test_priority_preempts_film_residency(solo_ref):
+    svc = _service(max_active=1)
+    lo = svc.submit(text=TEXT, tenant="batch", priority=0)
+    svc.step()
+    svc.step()
+    assert svc.jobs[lo].state is not None
+    hi = svc.submit(text=TEXT, tenant="live", priority=5)
+    jid = svc.step()
+    assert jid == hi, "higher class must schedule immediately"
+    assert svc.jobs[lo].state is None and svc.jobs[lo].preemptions == 1, (
+        "low-priority job must be parked via emergency checkpoint"
+    )
+    svc.drain()
+    for j in (lo, hi):
+        assert np.array_equal(
+            np.asarray(svc.result(j).image, np.float32), solo_ref
+        )
+
+
+def test_schedule_deterministic_across_services():
+    def run():
+        svc = _service(seed=3)
+        svc.submit(text=TEXT, tenant="a")
+        svc.submit(text=TEXT, tenant="b", weight=2.0)
+        svc.drain()
+        return list(svc.schedule)
+
+    assert run() == run()
+
+
+def test_preview_streams_partial_develop(tmp_path, solo_ref):
+    svc = _service()
+    out = tmp_path / "preview.pfm"
+    j = svc.submit(text=TEXT, preview_every=1, preview_path=str(out))
+    svc.step()
+    assert out.exists(), "preview cadence wrote nothing"
+    from tpu_pbrt.utils.imageio import read_image
+
+    img = np.asarray(read_image(str(out)), np.float32)
+    assert img.shape == solo_ref.shape
+    assert np.isfinite(img).all()
+    live = svc.preview(j)  # the on-demand primitive
+    assert np.isfinite(np.asarray(live)).all()
+    svc.drain()
+    assert svc.jobs[j].previews >= 1
+
+
+def test_unsliceable_integrator_rejected_at_submit():
+    """SPPM/MLT own their render loops (no chunk-plan seam): the service
+    must refuse at submit time with a clear error, not fail the first
+    dispatch."""
+    svc = _service()
+    sppm_text = cornell_box_text(res=16, spp=1, integrator="sppm")
+    with pytest.raises(ValueError, match="cannot be served"):
+        svc.submit(text=sppm_text)
+
+
+def test_step_failure_quarantines_job_not_service(solo_ref):
+    """An unexpected per-job crash (here: a resume whose checkpoint was
+    written for a DIFFERENT render configuration — the fingerprint
+    guard) fails THE JOB; other tenants keep rendering and the failed
+    job's residency pin is released."""
+    from tpu_pbrt.parallel.checkpoint import save_checkpoint
+
+    svc = _service()
+    good = svc.submit(text=TEXT)
+    bad = svc.submit(text=TEXT, tenant="other")
+    film = svc.residency.get(svc.jobs[bad].resident_key).scene.film
+    save_checkpoint(
+        svc.jobs[bad].checkpoint_path, film.init_state(), 0, 0,
+        fingerprint="some-other-render-config",
+    )
+    svc.drain()
+    assert svc.jobs[bad].status == "failed"
+    assert "fingerprint" in svc.jobs[bad].error or svc.jobs[bad].error
+    assert np.array_equal(
+        np.asarray(svc.result(good).image, np.float32), solo_ref
+    )
+    # the failed job no longer pins its scene
+    assert svc.residency.get(svc.jobs[bad].resident_key).pins == 0
+
+
+# --------------------------------------------------------------------------
+# one CPU mesh: the acceptance scenario
+# --------------------------------------------------------------------------
+
+
+def test_concurrent_jobs_on_mesh_bit_identical_with_preempt():
+    """ISSUE 6 acceptance: two concurrent submits on ONE CPU mesh, both
+    bit-identical to their solo run-to-completion renders, including a
+    preempt/resume cycle on one of them."""
+    from tpu_pbrt.parallel.mesh import make_mesh
+
+    mesh = make_mesh(2)
+    scene, integ = compile_string(TEXT, Options(quiet=True))
+    ref = np.asarray(integ.render(scene, mesh=mesh).image, np.float32)
+
+    svc = _service(mesh=mesh)
+    j1 = svc.submit(text=TEXT, tenant="alice")
+    j2 = svc.submit(text=TEXT, tenant="bob")
+    for _ in range(3):
+        svc.step()
+    svc.preempt(j2)
+    svc.step()
+    svc.resume(j2)
+    svc.drain()
+    for j in (j1, j2):
+        img = np.asarray(svc.result(j).image, np.float32)
+        assert np.isfinite(img).all()
+        assert np.array_equal(img, ref), f"{j} differs from mesh solo"
+    assert svc.jobs[j2].preemptions == 1

@@ -62,6 +62,14 @@ python -m tpu_pbrt.obs "$SMOKE_DIR/trace.json" \
 echo "== chaos recovery matrix (python -m tpu_pbrt.chaos)"
 python -m tpu_pbrt.chaos
 
+# render-service smoke (ISSUE 6): submit two cropped cornell jobs to one
+# service, preempt/resume one mid-render, and require both films finite
+# AND bit-identical to a solo run-to-completion render, a warm resubmit
+# with 0 scene compiles + 0 jit retraces, and >= 1 streamed preview.
+echo "== render service smoke (python -m tpu_pbrt.serve --selftest)"
+XLA_FLAGS="${XLA_FLAGS:-} --xla_backend_optimization_level=0" \
+python -m tpu_pbrt.serve --selftest
+
 if [[ "${1:-}" == "--fast" ]]; then
     echo "== pytest skipped (--fast)"
     exit 0

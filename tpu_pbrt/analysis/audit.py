@@ -267,6 +267,28 @@ def sppm_pass_jaxprs():
     return cam, photon
 
 
+def serve_step_jaxpr():
+    """Trace the render service's slice-dispatch entry point (ISSUE 6):
+    the ChunkPlan closure the service schedules one chunk-slice of per
+    step, at a service-shaped slice width (smaller than the batch
+    chunk — the preemption quantum). This is the program every serve
+    dispatch runs, so the budget gate covers the serving hot path even
+    with the accelerator down."""
+    import jax
+    import jax.numpy as jnp
+
+    scene, integ = _stream_scene("path")
+    film = scene.film
+    plan = integ.prepare_chunks(scene, chunk=256)
+
+    def fn(fs, start_pix, start_s):
+        return plan.jfn(fs, scene.dev, start_pix, start_s)
+
+    return jax.make_jaxpr(fn)(
+        film.init_state(), jnp.int32(0), jnp.int32(0)
+    )
+
+
 def mesh_step_jaxpr():
     """Trace the sharded_pool_renderer SPMD step over a 1..n-device CPU
     mesh (the ICI film-merge psum + per-device drain)."""
@@ -441,6 +463,8 @@ def run_audit(include_compile: bool = True) -> List[str]:
             "film.add_samples_pixel", film_deposit_jaxpr(pixel_path=True))),
         ("mesh step jaxpr", lambda: _jaxpr_invariants(
             "sharded_pool_renderer", mesh_step_jaxpr())),
+        ("serve step jaxpr", lambda: _jaxpr_invariants(
+            "serve_step", serve_step_jaxpr())),
     ]
     if include_compile:
         checks += [

@@ -580,6 +580,9 @@ def default_entry_points():
             audit.film_deposit_jaxpr(pixel_path=True), 64,
         ),
         "mesh_step": lambda: (audit.mesh_step_jaxpr(), 64),
+        # the render service's slice dispatch (ISSUE 6): same pool drain,
+        # service-shaped slice width — the serving hot path's own budget
+        "serve_step": lambda: (audit.serve_step_jaxpr(), 64),
     }
 
 

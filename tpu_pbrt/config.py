@@ -83,6 +83,9 @@ class Config:
         "mipfilter",
         "chunk",
         "pool",
+        "deposit_seg",
+        "serve_chunk",
+        "serve_resident_mb",
         "audit_drops",
         "allow_drops",
         "shard_native_check",
@@ -132,6 +135,22 @@ class Config:
         self.chunk: Optional[int] = _int("TPU_PBRT_CHUNK", None)
         #: path-pool slots (0 -> per_dev/4 heuristic)
         self.pool: int = _int("TPU_PBRT_POOL", 0)
+        #: segmented pool film deposit: width of the per-wave deposit
+        #: window (terminated lanes are sorted to a contiguous prefix and
+        #: only the window is scattered — the full-pool-width scatter was
+        #: the ROADMAP "pool deposit path" carried item). 0 = auto
+        #: (pool/4 once the pool is big enough to amortize the extra
+        #: sort); >= pool or negative = full-width (the exact pre-segment
+        #: program)
+        self.deposit_seg: int = _int("TPU_PBRT_DEPOSIT_SEG", 0)
+        #: render-service slice width (camera rays per submit/step
+        #: quantum — the preemption granularity; None = platform chunk)
+        self.serve_chunk: Optional[int] = _int("TPU_PBRT_SERVE_CHUNK", None)
+        #: render-service resident-scene HBM budget in MB (LRU eviction
+        #: above it; None = unbounded)
+        self.serve_resident_mb: Optional[float] = _float(
+            "TPU_PBRT_SERVE_RESIDENT_MB", None
+        )
         #: pre-render stream-capacity audit (overflows fail loudly)
         self.audit_drops: bool = _flag("TPU_PBRT_AUDIT_DROPS", True)
         #: downgrade a detected capacity overflow to a warning

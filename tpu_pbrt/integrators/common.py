@@ -287,6 +287,152 @@ def _fixed_batch_nonfinite(p_film, L):
 
 
 @dataclass
+class ChunkPlan:
+    """The chunked decomposition of one render's work domain plus the
+    (cached) jitted dispatch closure — everything needed to advance a
+    render one idempotent chunk at a time.
+
+    This is the submit/step seam the render service (tpu_pbrt/serve)
+    schedules on: ``dispatch(state, c)`` runs chunk ``c`` against a film
+    accumulator and returns the new state + accounting aux, and the
+    (film state, chunk cursor, rays, counters) tuple a caller carries
+    between dispatches is exactly the checkpoint-v4 payload — so any
+    job can be parked mid-render (emergency checkpoint, PR 5's path)
+    and resumed with no lost work. ``WavefrontIntegrator.render`` below
+    is one scheduling policy over this plan (run to completion with the
+    recovery ladder); the multi-tenant service loop is another."""
+
+    integrator: Any
+    scene: Any
+    mesh: Any
+    film: Any
+    cam: Any
+    chunk: int
+    per_dev: int
+    n_dev: int
+    n_chunks: int
+    spp: int
+    total: int
+    npix: int
+    bounds: tuple  # film sample bounds (x0, x1, y0, y1)
+    pool: int
+    use_regen: bool
+    chaos_nan: bool
+    starts: list
+    jfn: Any
+    fingerprint: str
+
+    def dispatch(self, state, c: int):
+        """Dispatch chunk ``c`` against ``state`` (the film accumulator
+        is DONATED — callers must use the returned state and never touch
+        the argument again). Returns (state, aux)."""
+        st = self.starts[c]
+        if self.mesh is None and self.chaos_nan:
+            from tpu_pbrt.chaos import CHAOS
+
+            nanw = jax.device_put(np.int32(CHAOS.nan_wave_for(c)))
+            return self.jfn(state, self.scene.dev, st[0], st[1], nanw)
+        if self.mesh is None:
+            return self.jfn(state, self.scene.dev, st[0], st[1])
+        return self.jfn(state, self.scene.dev, st)
+
+    def aux_parts(self, aux):
+        """Split a dispatch's aux into (nrays, occ, ctr, spread, nf):
+        occ = (live, waves, truncated) on the regen path, ctr/spread
+        the telemetry blocks (None when killed), nf the fixed-batch
+        firewall scrub count. Mirrors render()'s inline unpacking for
+        other schedulers (the render service)."""
+        if self.use_regen:
+            nrays = aux[0]
+            occ = tuple(aux[1:4])
+            ctr = aux[4] if len(aux) > 4 else None
+            spread = aux[5] if len(aux) > 5 else None
+            return nrays, occ, ctr, spread, None
+        if isinstance(aux, tuple):
+            return aux[0], None, None, None, aux[1]
+        return aux, None, None, None, None
+
+    def capacity_audit(self):
+        """Pre-render stream-capacity audit (DEFAULT ON — an overflow
+        must fail in seconds, not after the full render has been paid
+        for): re-trace one camera-ray chunk through the stats variant of
+        the stream tracer and FAIL loudly if any traversal pair was
+        dropped to capacity (silent false misses otherwise). Audits the
+        primary wave only — bounce waves produce FEWER simultaneous
+        pairs (dead lanes cull at init), so the camera wave bounds the
+        live worklist for a given chunk size. TPU_PBRT_AUDIT_DROPS=0
+        opts out; the drop count is memoized per (scene, chunk) so
+        repeat preparations (warm service resubmits) pay nothing."""
+        dev = self.scene.dev
+        if not cfg.audit_drops or "tstream" not in dev:
+            return
+        integ = self.integrator
+        memo = getattr(integ, "_audit_memo", None)
+        if memo is None:
+            memo = integ._audit_memo = {}
+        # CompiledScene is not hashable: key by identity, keep the strong
+        # ref in the value so the id can never be recycled under the memo
+        audit_key = (self.scene, self.chunk)
+        memo_key = (id(self.scene), self.chunk)
+        if memo_key in memo:
+            drops = memo[memo_key][1]
+        else:
+            from tpu_pbrt.accel.stream import stream_traverse_stats
+            from tpu_pbrt.obs.trace import TRACE
+
+            x0, _, y0, _ = self.bounds
+            w = self.bounds[1] - self.bounds[0]
+            chunk, total, spp, cam = self.chunk, self.total, self.spp, self.cam
+            cached_audit = getattr(integ, "_audit_jit", None)
+            if (
+                cached_audit is not None
+                and cached_audit[0][0] is self.scene
+                and cached_audit[0][1] == chunk
+            ):
+                audit_rays = cached_audit[1]
+            else:
+
+                @jax.jit
+                def audit_rays():
+                    # staged under jit: eager array creation would be an
+                    # implicit transfer under the audit's transfer guard.
+                    # Cached across render() calls (like the chunk
+                    # closure) so repeat renders stay at 0 recompiles.
+                    k = jnp.arange(min(chunk, total), dtype=jnp.int32)
+                    pix = k // spp
+                    p_film0 = jnp.stack(
+                        [(x0 + pix % w).astype(jnp.float32) + 0.5,
+                         (y0 + pix // w).astype(jnp.float32) + 0.5], axis=-1)
+                    o0, d0, _ = generate_rays(
+                        cam, p_film0, jnp.zeros_like(p_film0)
+                    )
+                    return o0, d0
+
+                integ._audit_jit = (audit_key, audit_rays)
+
+            with TRACE.span("render/capacity_audit"):
+                o0, d0 = audit_rays()
+                *_, drops, _ = stream_traverse_stats(
+                    dev["tstream"], o0, d0,
+                    jax.device_put(np.float32(np.inf)),
+                )
+                drops = int(jax.device_get(drops))
+            memo[memo_key] = (self.scene, drops)
+        if drops > 0:
+            msg = (
+                f"stream tracer dropped {drops} traversal pairs to "
+                "capacity on the camera wave — the render may have false "
+                "misses; lower TPU_PBRT_CHUNK or raise TPU_PBRT_HEADROOM"
+            )
+            if cfg.allow_drops:
+                from tpu_pbrt.utils.error import Warning as _W
+
+                _W(msg)
+            else:
+                raise RuntimeError(msg)
+
+
+@dataclass
 class RenderResult:
     image: np.ndarray
     film_state: Any
@@ -723,33 +869,25 @@ class WavefrontIntegrator:
     def li(self, dev, o, d, px, py, s):
         raise NotImplementedError
 
-    # -- the loop ---------------------------------------------------------
-    def render(
-        self, scene=None, mesh=None, checkpoint_path=None, checkpoint_every=0,
-        max_seconds: float = 0.0,
-    ) -> RenderResult:
-        """The SamplerIntegrator::Render loop. mesh=None runs single-device;
-        a jax.sharding.Mesh runs the SPMD tile scheduler (parallel/mesh.py):
-        work indices round-robined across devices, film merged by psum.
+    # -- chunk-plan preparation (the submit/step seam) --------------------
+    def prepare_chunks(
+        self, scene=None, mesh=None, chunk: Optional[int] = None,
+    ) -> ChunkPlan:
+        """Build (or re-use, via the single-slot jit cache) the chunk
+        decomposition + jitted dispatch closure for rendering ``scene``
+        on ``mesh``. ``chunk`` overrides the platform-default chunk size
+        — the render service passes its slice width here so one
+        submit/step quantum stays small enough to preempt between.
 
-        max_seconds > 0 time-boxes the loop: after the budget elapses the
-        loop stops at a chunk boundary and returns a partial render with
-        completed_fraction < 1. NOTE the work domain is pixel-major, so a
-        partial film is spatially truncated (trailing pixels unsampled) —
-        only valid for throughput measurement or checkpointed resume, not
-        for image comparison. The throughput meter stays valid — it
-        divides rays actually traced by wall time. The stop can overshoot
-        the budget by a few in-flight chunk durations (the sync lags the
-        dispatch to keep the pipe full)."""
+        Pure preparation: nothing is dispatched. Repeat calls with the
+        same (scene, mesh, chunk, knobs) return a plan sharing the SAME
+        compiled closure — the 0-recompile contract the jaxpr audit and
+        the service's warm-resubmit criterion both pin."""
         scene = scene or self.scene
         if mesh is None and getattr(self.options, "mesh_shape", None):
-            import jax as _jax
+            from tpu_pbrt.parallel.mesh import resolve_mesh
 
-            from tpu_pbrt.parallel.mesh import make_mesh
-
-            n_req = int(np.prod(self.options.mesh_shape))
-            if n_req > 1 and len(_jax.devices()) >= n_req:
-                mesh = make_mesh(n_req)
+            mesh = resolve_mesh(self.options.mesh_shape)
         film = scene.film
         cam = scene.camera
         dev = scene.dev
@@ -760,7 +898,6 @@ class WavefrontIntegrator:
         spp = scene.sampler.spp
         total = npix * spp
         n_dev = 1 if mesh is None else mesh.devices.size
-        import os as _os
 
         # Default chunk: the stream tracer's sort/compaction steps amortize
         # over BIG waves, so TPU dispatches carry 1M camera rays (a path
@@ -777,9 +914,11 @@ class WavefrontIntegrator:
             default_chunk = (1 << 20) if cfg.bvh == "stream" else (1 << 13)
         else:
             default_chunk = min(MAX_RAYS_PER_DISPATCH >> 1, 1 << 17)
-        chunk = int(cfg.chunk if cfg.chunk is not None else default_chunk)
+        if chunk is None:
+            chunk = int(cfg.chunk if cfg.chunk is not None else default_chunk)
+        chunk = int(chunk)
         chunk = min(chunk, max(1024 * n_dev, total))
-        chunk = (chunk // n_dev) * n_dev
+        chunk = max((chunk // n_dev) * n_dev, n_dev)
         per_dev = chunk // n_dev
         n_chunks = (total + chunk - 1) // chunk
 
@@ -828,8 +967,9 @@ class WavefrontIntegrator:
         # the jitted chunk function across calls (single slot, keyed on the
         # scene object identity + static loop parameters) so repeat renders
         # of the same scene — bench warmup, spp-chunked loops, resumed
-        # checkpoints — hit the compile cache. The cache holds a strong ref
-        # to the scene, keeping the keyed identity stable.
+        # checkpoints, warm service resubmits — hit the compile cache. The
+        # cache holds a strong ref to the scene, keeping the keyed identity
+        # stable.
         # the telemetry kill switch changes the traced program (counter
         # carry present/absent), so it is part of the closure identity —
         # a reload() between renders must not reuse the stale closure
@@ -950,7 +1090,7 @@ class WavefrontIntegrator:
                 jfn = jax.jit(chunk_fn, donate_argnums=(0,))
             self._jit_cache = (jit_key, jfn)
 
-        # start cursors move host->device once per chunk; the transfer is
+        # start cursors move host->device once per plan; the transfer is
         # EXPLICIT (device_put) so the whole loop runs clean under
         # jax.transfer_guard("disallow") — the jaxpr audit's smoke render
         if mesh is None:
@@ -969,6 +1109,39 @@ class WavefrontIntegrator:
                     jax.device_put(np.asarray(pairs, np.int32))
                 )  # (n_dev, 2)
 
+        fp = render_fingerprint(chunk=chunk, spp=spp, total=total, scene=scene)
+        return ChunkPlan(
+            integrator=self, scene=scene, mesh=mesh, film=film, cam=cam,
+            chunk=chunk, per_dev=per_dev, n_dev=n_dev, n_chunks=n_chunks,
+            spp=spp, total=total, npix=npix, bounds=(x0, x1, y0, y1),
+            pool=pool, use_regen=use_regen, chaos_nan=chaos_nan,
+            starts=starts, jfn=jfn, fingerprint=fp,
+        )
+
+    # -- the loop ---------------------------------------------------------
+    def render(
+        self, scene=None, mesh=None, checkpoint_path=None, checkpoint_every=0,
+        max_seconds: float = 0.0,
+    ) -> RenderResult:
+        """The SamplerIntegrator::Render loop. mesh=None runs single-device;
+        a jax.sharding.Mesh runs the SPMD tile scheduler (parallel/mesh.py):
+        work indices round-robined across devices, film merged by psum.
+
+        max_seconds > 0 time-boxes the loop: after the budget elapses the
+        loop stops at a chunk boundary and returns a partial render with
+        completed_fraction < 1. NOTE the work domain is pixel-major, so a
+        partial film is spatially truncated (trailing pixels unsampled) —
+        only valid for throughput measurement or checkpointed resume, not
+        for image comparison. The throughput meter stays valid — it
+        divides rays actually traced by wall time. The stop can overshoot
+        the budget by a few in-flight chunk durations (the sync lags the
+        dispatch to keep the pipe full)."""
+        plan = self.prepare_chunks(scene, mesh)
+        scene, mesh, film = plan.scene, plan.mesh, plan.film
+        spp, total = plan.spp, plan.total
+        n_chunks, pool = plan.n_chunks, plan.pool
+        use_regen = plan.use_regen
+
         # -- checkpoint/resume (SURVEY.md §5.4): film accumulation is
         # associative and chunks are idempotent, so a checkpoint is just
         # (film state, chunk cursor); the counter-based RNG makes resumed
@@ -982,77 +1155,20 @@ class WavefrontIntegrator:
         prev_rays = 0
         prev_ctr: Dict[str, Any] = {}
         state = film.init_state()
-        fp = render_fingerprint(chunk=chunk, spp=spp, total=total, scene=scene)
+        fp = plan.fingerprint
         if ckpt_path and checkpoint_exists(ckpt_path):
             state, first_chunk, prev_rays, prev_ctr = load_checkpoint(
                 ckpt_path, fp
             )
 
+        from tpu_pbrt.chaos import CHAOS
         from tpu_pbrt.obs import counters as obs_counters
         from tpu_pbrt.obs.flight import FLIGHT
         from tpu_pbrt.obs.trace import TRACE
 
-        if cfg.audit_drops and "tstream" in dev:
-            # Capacity audit, DEFAULT ON, BEFORE the render loop (an
-            # overflow must fail in seconds, not after the full render
-            # has been paid for): the stream
-            # tracer's worklists are heuristically sized (accel/stream.py
-            # _sizes) and a capacity overflow silently drops the NEAREST
-            # subtrees (false misses). Re-trace one camera-ray chunk
-            # through the stats variant and FAIL loudly if any pair was
-            # dropped. This audits the primary wave only — bounce waves
-            # produce FEWER simultaneous pairs (dead lanes cull at init),
-            # so the camera wave bounds the live worklist for a given
-            # chunk size. TPU_PBRT_AUDIT_DROPS=0 opts out.
-            from tpu_pbrt.accel.stream import stream_traverse_stats
-
-            audit_key = (scene, chunk)
-            cached_audit = getattr(self, "_audit_jit", None)
-            if (
-                cached_audit is not None
-                and cached_audit[0][0] is scene
-                and cached_audit[0][1] == chunk
-            ):
-                audit_rays = cached_audit[1]
-            else:
-
-                @jax.jit
-                def audit_rays():
-                    # staged under jit: eager array creation would be an
-                    # implicit transfer under the audit's transfer guard.
-                    # Cached across render() calls (like the chunk
-                    # closure) so repeat renders stay at 0 recompiles.
-                    k = jnp.arange(min(chunk, total), dtype=jnp.int32)
-                    pix = k // spp
-                    p_film0 = jnp.stack(
-                        [(x0 + pix % w).astype(jnp.float32) + 0.5,
-                         (y0 + pix // w).astype(jnp.float32) + 0.5], axis=-1)
-                    o0, d0, _ = generate_rays(
-                        cam, p_film0, jnp.zeros_like(p_film0)
-                    )
-                    return o0, d0
-
-                self._audit_jit = (audit_key, audit_rays)
-
-            with TRACE.span("render/capacity_audit"):
-                o0, d0 = audit_rays()
-                *_, drops, _ = stream_traverse_stats(
-                    dev["tstream"], o0, d0,
-                    jax.device_put(np.float32(np.inf)),
-                )
-                drops = int(jax.device_get(drops))
-            if drops > 0:
-                msg = (
-                    f"stream tracer dropped {drops} traversal pairs to "
-                    "capacity on the camera wave — the render may have false "
-                    "misses; lower TPU_PBRT_CHUNK or raise TPU_PBRT_HEADROOM"
-                )
-                if cfg.allow_drops:
-                    from tpu_pbrt.utils.error import Warning as _W
-
-                    _W(msg)
-                else:
-                    raise RuntimeError(msg)
+        # pre-render stream-capacity audit (fails loudly on a worklist
+        # overflow — see ChunkPlan.capacity_audit)
+        plan.capacity_audit()
 
         quiet = bool(getattr(self.options, "quiet", False))
         progress = ProgressReporter(n_chunks, "Rendering", quiet=quiet)
@@ -1154,7 +1270,6 @@ class WavefrontIntegrator:
         retry_t0 = None  # wall clock of the current failure streak
         with STATS.phase("Integrator/Render loop"):
             while c < n_chunks:
-                st = starts[c]
                 try:
                     # failure seam (SURVEY.md §2e worker-failure row): a
                     # dispatch that dies is re-run — chunks are idempotent
@@ -1177,17 +1292,7 @@ class WavefrontIntegrator:
                             if c == first_chunk else "render/chunk_dispatch",
                             chunk=c,
                         ):
-                            if mesh is None and chaos_nan:
-                                nanw = jax.device_put(
-                                    np.int32(CHAOS.nan_wave_for(c))
-                                )
-                                state, aux = jfn(
-                                    state, dev, st[0], st[1], nanw
-                                )
-                            elif mesh is None:
-                                state, aux = jfn(state, dev, st[0], st[1])
-                            else:
-                                state, aux = jfn(state, dev, st)
+                            state, aux = plan.dispatch(state, c)
                     except jax.errors.JaxRuntimeError as e:
                         # real device/runtime loss mid-dispatch: the donated
                         # film accumulator can no longer be trusted — route

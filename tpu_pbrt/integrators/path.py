@@ -638,6 +638,7 @@ class PathIntegrator(WavefrontIntegrator):
         recovery needs no recompile). None (no nan site in the plan)
         compiles no injection code at all.
         """
+        from tpu_pbrt.config import cfg
         from tpu_pbrt.obs import counters as obs_counters
 
         assert pool < (1 << _POOL_LANE_BITS)
@@ -649,6 +650,23 @@ class PathIntegrator(WavefrontIntegrator):
         spp = self.spp
         motion = "tri_verts1" in dev
         box_fast = film.pixel_deposit_ok()
+        # Segmented deposit (ROADMAP "pool deposit path" carried item):
+        # the in-loop film scatter ran full-pool-width per wave although
+        # only the terminated lanes carry a deposit. One extra packed-i32
+        # single-key sort (the compaction's fast path) moves this wave's
+        # terminated lanes to a contiguous prefix and only a static
+        # `seg`-wide window is gathered + scattered — ~pool/seg less
+        # scatter traffic per wave; a rare wave where more than `seg`
+        # lanes terminate at once falls back to the full-width scatter
+        # (lax.cond in the body), so drain length and occupancy are
+        # untouched. seg >= pool compiles the exact pre-segment program
+        # (no sort, no cond).
+        seg = int(cfg.deposit_seg)
+        if seg == 0:
+            seg = pool // 4 if pool >= 256 else pool
+        if seg < 0 or seg > pool:
+            seg = pool
+        seg = max(seg, 1)
         # worst case: every refill round runs every lane to max_depth,
         # plus the shadow-settle wave — a static safety bound only
         max_waves = (n_work // pool + 2) * (self.max_depth + 2) + 8
@@ -766,11 +784,7 @@ class PathIntegrator(WavefrontIntegrator):
                         done & nonfinite_mask(lane.L), dtype=jnp.int32
                     ),
                 )
-            if box_fast:
-                # box(0.5): one masked own-pixel scatter, matching the
-                # aligned path the fixed-batch single-device render uses
-                fs = film.add_samples_pixel(ps.fs, px, py, lane.L, done, wt)
-            else:
+            if not box_fast:
                 # general filter footprint: recompute the film jitter
                 # (a pure function of the work item) and mask the
                 # not-yet-terminated lanes out of the crop window
@@ -779,6 +793,69 @@ class PathIntegrator(WavefrontIntegrator):
                     [px.astype(jnp.float32) + fx,
                      py.astype(jnp.float32) + fy], axis=-1,
                 )
+            if seg < pool:
+                # SEGMENTED deposit: one more packed-i32 single-key sort
+                # (the compaction's fast path) moves this wave's
+                # terminated lanes to a contiguous prefix — stable on
+                # lane index, so the gathered batch deposits in exactly
+                # the full-width scatter's relative order (bit-identity)
+                # — and only a static `seg`-wide window is scattered.
+                # The rare wave where MORE than `seg` lanes terminate at
+                # once takes the full-width branch of the lax.cond
+                # instead, so no lane ever waits for a window slot (a
+                # deferred-deposit design measurably stalled
+                # regeneration: occupancy 0.52 vs 0.96 on the depth-5
+                # occupancy scene).
+                dkey = lane_idx | jnp.where(
+                    done, 0, jnp.int32(1) << _POOL_LANE_BITS
+                )
+                (dkey_s,) = jax.lax.sort([dkey], num_keys=1)
+                dperm = (dkey_s & ((1 << _POOL_LANE_BITS) - 1))[:seg]
+                dmask = jnp.take(done, dperm)
+
+                if box_fast:
+
+                    def _dep_seg(fs0):
+                        return film.add_samples_pixel(
+                            fs0, jnp.take(px, dperm), jnp.take(py, dperm),
+                            jnp.take(lane.L, dperm, axis=0), dmask,
+                            jnp.take(wt, dperm),
+                        )
+
+                    def _dep_full(fs0):
+                        return film.add_samples_pixel(
+                            fs0, px, py, lane.L, done, wt
+                        )
+
+                else:
+
+                    def _dep_seg(fs0):
+                        return film.add_samples(
+                            fs0,
+                            jnp.where(
+                                dmask[..., None],
+                                jnp.take(p_film, dperm, axis=0), -1e6,
+                            ),
+                            jnp.take(lane.L, dperm, axis=0),
+                            jnp.take(wt, dperm),
+                        )
+
+                    def _dep_full(fs0):
+                        return film.add_samples(
+                            fs0,
+                            jnp.where(done[..., None], p_film, -1e6),
+                            lane.L, wt,
+                        )
+
+                fs = jax.lax.cond(
+                    jnp.sum(done, dtype=jnp.int32) <= seg,
+                    _dep_seg, _dep_full, ps.fs,
+                )
+            elif box_fast:
+                # box(0.5): one masked own-pixel scatter, matching the
+                # aligned path the fixed-batch single-device render uses
+                fs = film.add_samples_pixel(ps.fs, px, py, lane.L, done, wt)
+            else:
                 fs = film.add_samples(
                     ps.fs, jnp.where(done[..., None], p_film, -1e6),
                     lane.L, wt,

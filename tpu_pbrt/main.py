@@ -20,7 +20,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
         prog="tpu-pbrt",
         description="TPU-native physically based renderer (pbrt-v3 scene compatible)",
     )
-    p.add_argument("scenes", nargs="+", help=".pbrt scene file(s) to render")
+    p.add_argument("scenes", nargs="*", help=".pbrt scene file(s) to render")
+    p.add_argument(
+        "--serve",
+        action="store_true",
+        help="run as a persistent render service: scenes given on the "
+        "command line are submitted as initial jobs, then a stdin/JSONL "
+        "daemon accepts submit/poll/preempt/cancel ops (protocol: "
+        "python -m tpu_pbrt.serve --help, README 'Render service')",
+    )
     p.add_argument("--outfile", "-o", default="", help="output image filename (overrides scene Film)")
     p.add_argument("--quick", action="store_true", help="reduce samples/resolution for a fast preview")
     p.add_argument("--quiet", action="store_true", help="suppress progress/warning messages")
@@ -64,6 +72,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
+    if not args.scenes and not args.serve:
+        print("tpu-pbrt: no scene files (and no --serve)", file=sys.stderr)
+        return 1
     opts = Options(
         n_threads=args.nthreads,
         quick_render=args.quick,
@@ -80,13 +91,45 @@ def main(argv=None) -> int:
     from tpu_pbrt.obs.trace import TRACE
     from tpu_pbrt.parallel.mesh import maybe_init_distributed
 
-    if args.trace:
-        TRACE.configure(args.trace)
+    # chaos BEFORE the telemetry arm-up: a fault plan that targets the
+    # very first dispatch (or the trace exporter itself) must already be
+    # installed when instrumentation comes online — and both before
+    # jax.distributed, whose init is a dispatch-bearing phase
     if args.faults:
         from tpu_pbrt.chaos import CHAOS
 
         CHAOS.install(args.faults)
+    if args.trace:
+        TRACE.configure(args.trace)
     maybe_init_distributed(opts)
+    if args.serve:
+        from tpu_pbrt.parallel.mesh import resolve_mesh
+        from tpu_pbrt.serve import RenderService
+        from tpu_pbrt.serve.__main__ import run_daemon
+
+        service = RenderService(
+            mesh=resolve_mesh(opts.mesh_shape), quiet=args.quiet,
+        )
+        for i, scene in enumerate(args.scenes):
+            # one --checkpoint path cannot be shared by several jobs
+            # (interleaved writes would clobber each other and the
+            # fingerprint guard would fail the second resume): key it
+            # per scene when more than one is submitted
+            ckpt = args.checkpoint
+            if ckpt and len(args.scenes) > 1:
+                ckpt = f"{ckpt}.{i}"
+            job = service.submit(
+                scene, options=opts,
+                checkpoint_path=ckpt,
+                checkpoint_every=args.checkpoint_every,
+                outfile=args.outfile,
+            )
+            if not args.quiet:
+                print(f"tpu-pbrt: submitted {scene} as {job}", file=sys.stderr)
+        try:
+            return run_daemon(service)
+        finally:
+            TRACE.maybe_export()
     try:
         for scene in args.scenes:
             try:

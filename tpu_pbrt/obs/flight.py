@@ -81,6 +81,23 @@ class FlightRecorder:
         self.heartbeat(phase, counters=snapshot)
 
 
+def job_flight_path(base: Optional[str], job_id: str) -> Optional[str]:
+    """Per-job flight file next to `base` — `flight.jsonl` ->
+    `flight.<job>.jsonl`. The render service re-arms the recorder with
+    this per job slice it dispatches: a shared default path (bench's
+    BENCH_flight.jsonl) would interleave heartbeat lines from every
+    concurrent job into one undiagnosable stream."""
+    if not base:
+        return None
+    import os
+
+    # splitext (not a raw '.' split): it only splits the BASENAME, so a
+    # dotted directory (/tmp/run.1/flight) can't be mangled into a
+    # nonexistent path whose writes the recorder would silently drop
+    root, ext = os.path.splitext(base)
+    return f"{root}.{job_id}{ext}"
+
+
 FLIGHT = FlightRecorder()
 
 

@@ -199,6 +199,21 @@ def save_checkpoint(
     _fsync_dir(path)
 
 
+def delete_checkpoint(path: str) -> None:
+    """Remove a checkpoint and every sibling artifact the writer can
+    leave behind (`.prev` rotation, orphaned `.tmp.npz` from a crash
+    between write and rename). The render service calls this when a
+    cancelled/finished job releases its spool slot — a stale file would
+    otherwise resume into the NEXT job that reuses the path (the
+    fingerprint guard would refuse, but refusing loudly at submit time
+    is worse than never seeing the corpse)."""
+    for p in (path, path + ".prev", path + ".tmp", path + ".tmp.npz"):
+        try:
+            os.remove(p)
+        except FileNotFoundError:
+            pass
+
+
 def render_fingerprint(*, chunk: int, spp: int, total: int, scene) -> str:
     """The resume-compatibility key: chunk size depends on TPU_PBRT_CHUNK
     and device count, spp/total on the scene spec, and the film arrays on

@@ -126,6 +126,22 @@ def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     return Mesh(np.array(devs[:n]), (TILE_AXIS,))
 
 
+def resolve_mesh(mesh_shape) -> Optional[Mesh]:
+    """Options.mesh_shape -> Mesh (or None for single-device): the CLI's
+    '--mesh 2,4' spelling resolved against the live device set. Shared
+    by the run-to-completion render loop and the render service so both
+    frontends mean the same thing by the same flag. A request for more
+    devices than exist degrades to single-device (matching the render
+    loop's historical behavior) rather than erroring — the scene still
+    renders, just not sharded."""
+    if not mesh_shape:
+        return None
+    n_req = int(np.prod(tuple(mesh_shape)))
+    if n_req > 1 and len(jax.devices()) >= n_req:
+        return make_mesh(n_req)
+    return None
+
+
 def device_spread(value, n_dev: int, axis: str = TILE_AXIS):
     """One-hot scatter of a per-device scalar into an (n_dev,) vector:
     device i contributes `value` at slot i, zeros elsewhere, so the

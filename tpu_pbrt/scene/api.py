@@ -197,6 +197,12 @@ class PbrtAPI:
         self.current_instance: Optional[List[ShapeRecord]] = None
         self.scene_dir = "."
         self.scene: Any = None  # set by world_end
+        #: submit/step seam (tpu_pbrt/serve): when True, WorldEnd compiles
+        #: the scene and builds the integrator but does NOT run the
+        #: render-to-completion loop — the pair lands in `self.compiled`
+        #: for a scheduler (the render service) to drive chunk by chunk
+        self.defer_render = False
+        self.compiled: Any = None  # (CompiledScene, integrator) when deferred
 
     # -- state checks -----------------------------------------------------
     def _verify_initialized(self, func):
@@ -498,7 +504,12 @@ class PbrtAPI:
             self.scene = compile_scene(self)
             integrator = make_integrator(self.render_options.integrator_name,
                                          self.render_options.integrator_params, self.scene, self.options)
-            self.result = result = integrator.render(self.scene)
+            if self.defer_render:
+                # serve seam: hand the compiled pair to the caller's
+                # scheduler instead of running to completion here
+                self.compiled = result = (self.scene, integrator)
+            else:
+                self.result = result = integrator.render(self.scene)
         # reset world state for a possible next frame (pbrt api.cpp WorldEnd:
         # fresh RenderOptions, identity CTM, default graphics state); the
         # completed frame stays inspectable via last_render_options
@@ -552,3 +563,31 @@ def render_file(path: str, options: Optional[Options] = None):
     api = pbrt_init(options)
     parse_file(path, api, render=True)
     return getattr(api, "result", None)
+
+
+def compile_file(path: str, options: Optional[Options] = None):
+    """Parse + compile a .pbrt scene file WITHOUT rendering it: returns
+    (CompiledScene, integrator) — the resident-scene unit the render
+    service caches and schedules (submit/step instead of
+    run-to-completion)."""
+    api = pbrt_init(options)
+    api.defer_render = True
+    parse_file(path, api, render=True)
+    if api.compiled is None:
+        from tpu_pbrt.utils.error import Error
+
+        Error(f"scene file {path!r} has no WorldEnd; nothing to compile")
+    return api.compiled
+
+
+def compile_string(contents: str, options: Optional[Options] = None):
+    """compile_file for in-memory scene text (the JSONL daemon's inline
+    submit payload)."""
+    api = pbrt_init(options)
+    api.defer_render = True
+    parse_string(contents, api, render=True)
+    if api.compiled is None:
+        from tpu_pbrt.utils.error import Error
+
+        Error("scene text has no WorldEnd; nothing to compile")
+    return api.compiled

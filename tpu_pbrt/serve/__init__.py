@@ -1,0 +1,38 @@
+"""tpu-serve: multi-tenant render service (ISSUE 6 tentpole).
+
+The serving layer the paper's master/worker fork implies but the batch
+CLI reproduction lacked: resident compiled scenes (serve/residency.py),
+a priority + weighted-fair queue with deterministic scheduling
+(serve/queue.py), and resumable render jobs preempted at wave
+granularity through the checkpoint-v4 emergency path
+(serve/service.py). Frontends: this library API, the stdin/JSONL
+daemon (`python -m tpu_pbrt.serve`, `--selftest` for the CI smoke), and
+`tpu-pbrt --serve` in main.py.
+"""
+
+from tpu_pbrt.serve.queue import FairScheduler, preemption_victim
+from tpu_pbrt.serve.residency import (
+    ResidencyCache,
+    ResidentScene,
+    scene_hbm_bytes,
+    scene_source_key,
+)
+from tpu_pbrt.serve.service import (
+    ACTIVE,
+    CANCELLED,
+    DONE,
+    FAILED,
+    PARKED,
+    PAUSED,
+    QUEUED,
+    RenderJob,
+    RenderService,
+)
+
+__all__ = [
+    "ACTIVE", "CANCELLED", "DONE", "FAILED", "PARKED", "PAUSED", "QUEUED",
+    "FairScheduler", "preemption_victim",
+    "ResidencyCache", "ResidentScene", "scene_hbm_bytes",
+    "scene_source_key",
+    "RenderJob", "RenderService",
+]

@@ -1,0 +1,394 @@
+"""`python -m tpu_pbrt.serve` — the render-service frontends.
+
+Default mode: a stdin/JSONL daemon. One JSON object per line in, one
+JSON object per line out (responses carry {"ok": ...}; asynchronous job
+completions are emitted as {"event": "done"/"failed", ...} lines).
+
+Ops:
+  {"op": "submit", "scene": "path.pbrt" | "text": "<inline scene>",
+   "job": "id?", "tenant": "t?", "priority": 0, "weight": 1.0,
+   "chunk": 0, "checkpoint": "path?", "checkpoint_every": 0,
+   "preview_every": 0, "preview": "out.png?", "outfile": "img.exr?",
+   "crop": [x0, x1, y0, y1]?, "quick": false}
+  {"op": "poll",    "job": "j1"}
+  {"op": "preempt", "job": "j1"}      # emergency checkpoint + park
+  {"op": "resume",  "job": "j1"}
+  {"op": "cancel",  "job": "j1"}      # releases residency
+  {"op": "preview", "job": "j1", "out": "live.png"}
+  {"op": "result",  "job": "j1", "out": "final.exr?"}
+  {"op": "stats"}
+  {"op": "shutdown", "drain": true}
+
+Between commands the daemon steps the service (one chunk-slice per
+step, policy-scheduled), so renders progress while the client is idle.
+EOF on stdin drains the remaining jobs and exits.
+
+`--selftest` runs the CI smoke (no stdin): submit two cropped-cornell
+jobs on one mesh, preempt/resume one mid-render, and assert both films
+are finite AND bit-identical to a solo run-to-completion render, the
+warm resubmit paid 0 scene compiles and 0 jit recompiles, and the
+preview stream wrote frames. Exit 0 = pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_pbrt.serve",
+        description="tpu-pbrt multi-tenant render service",
+    )
+    p.add_argument(
+        "--selftest", action="store_true",
+        help="run the service smoke (2 cropped cornell jobs, one "
+        "preempt/resume, bit-identity vs solo, residency warm-hit) and exit",
+    )
+    p.add_argument("--mesh", default="", help="device mesh shape, e.g. '4'")
+    p.add_argument(
+        "--chunk", type=int, default=0,
+        help="slice width in camera rays (preemption quantum; 0 = platform default)",
+    )
+    p.add_argument("--seed", type=int, default=0, help="scheduler seed")
+    p.add_argument(
+        "--max-resident-mb", type=float, default=0.0,
+        help="resident-scene HBM budget in MB (0 = unbounded)",
+    )
+    p.add_argument(
+        "--max-active", type=int, default=0,
+        help="max jobs holding live film state (0 = unbounded)",
+    )
+    p.add_argument("--spool", default="", help="checkpoint spool directory")
+    p.add_argument("--quiet", action="store_true")
+    return p
+
+
+def _make_service(args):
+    from tpu_pbrt.parallel.mesh import resolve_mesh
+    from tpu_pbrt.serve import RenderService
+
+    mesh_shape = (
+        tuple(int(x) for x in args.mesh.split(",")) if args.mesh else None
+    )
+    return RenderService(
+        mesh=resolve_mesh(mesh_shape),
+        chunk=args.chunk or None,
+        max_resident_bytes=(
+            int(args.max_resident_mb * 1e6) if args.max_resident_mb else None
+        ),
+        max_active=args.max_active or None,
+        seed=args.seed,
+        spool_dir=args.spool or None,
+        quiet=True,
+    )
+
+
+# --------------------------------------------------------------------------
+# JSONL daemon
+# --------------------------------------------------------------------------
+
+
+def _emit(out, payload):
+    out.write(json.dumps(payload) + "\n")
+    out.flush()
+
+
+def _handle(service, req, out):
+    op = req.get("op")
+    try:
+        if op == "submit":
+            from tpu_pbrt.scene.api import Options
+
+            opts = Options(
+                quiet=True,
+                quick_render=bool(req.get("quick", False)),
+                crop_window=(
+                    tuple(req["crop"]) if req.get("crop") else None
+                ),
+                image_file=req.get("outfile", ""),
+            )
+            job = service.submit(
+                req.get("scene"),
+                text=req.get("text"),
+                options=opts,
+                job_id=req.get("job"),
+                tenant=req.get("tenant", "default"),
+                priority=int(req.get("priority", 0)),
+                weight=req.get("weight"),
+                chunk=int(req["chunk"]) if req.get("chunk") else None,
+                checkpoint_path=req.get("checkpoint", ""),
+                checkpoint_every=int(req.get("checkpoint_every", 0)),
+                preview_every=int(req.get("preview_every", 0)),
+                preview_path=req.get("preview", ""),
+                outfile=req.get("outfile", ""),
+            )
+            _emit(out, {"ok": True, "op": op, "job": job})
+        elif op == "poll":
+            _emit(out, {"ok": True, "op": op, **service.poll(req["job"])})
+        elif op == "preempt":
+            service.preempt(req["job"])
+            _emit(out, {"ok": True, "op": op, "job": req["job"]})
+        elif op == "resume":
+            service.resume(req["job"])
+            _emit(out, {"ok": True, "op": op, "job": req["job"]})
+        elif op == "cancel":
+            service.cancel(req["job"])
+            _emit(out, {"ok": True, "op": op, "job": req["job"]})
+        elif op == "preview":
+            img = service.preview(req["job"])
+            path = req.get("out", "")
+            if path:
+                from tpu_pbrt.utils import imageio
+
+                imageio.write_image(path, img)
+            _emit(out, {
+                "ok": True, "op": op, "job": req["job"],
+                "mean": float(img.mean()), "out": path or None,
+            })
+        elif op == "result":
+            r = service.result(req["job"])
+            path = req.get("out", "")
+            if path:
+                from tpu_pbrt.utils import imageio
+
+                imageio.write_image(path, r.image)
+            _emit(out, {
+                "ok": True, "op": op, "job": req["job"],
+                "rays": r.rays_traced,
+                "seconds": round(r.seconds, 3),
+                "mean": float(r.image.mean()),
+                "stats": _json_safe(r.stats), "out": path or None,
+            })
+        elif op == "stats":
+            _emit(out, {"ok": True, "op": op, **_json_safe(service.stats())})
+        elif op == "shutdown":
+            return "drain" if req.get("drain", True) else "now"
+        else:
+            _emit(out, {"ok": False, "error": f"unknown op {op!r}"})
+    except Exception as e:  # noqa: BLE001 — a bad request must not kill the daemon
+        _emit(out, {"ok": False, "op": op, "error": f"{type(e).__name__}: {e}"})
+    return None
+
+
+def _json_safe(obj):
+    """Counters and stats may carry numpy scalars; JSON needs ints."""
+    if isinstance(obj, dict):
+        return {str(k): _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if hasattr(obj, "item"):
+        return obj.item()
+    return obj
+
+
+def run_daemon(service, in_stream=None, out=None) -> int:
+    import queue as _q
+    import threading
+
+    in_stream = in_stream if in_stream is not None else sys.stdin
+    out = out if out is not None else sys.stdout
+    cmds: "_q.Queue" = _q.Queue()
+    eof = threading.Event()
+
+    def reader():
+        for line in in_stream:
+            cmds.put(line)
+        eof.set()
+
+    threading.Thread(target=reader, daemon=True).start()
+
+    done_emitted = set()
+    shutdown = None
+
+    def process_line(raw):
+        raw = raw.strip()
+        if not raw:
+            return None
+        try:
+            req = json.loads(raw)
+        except ValueError as e:
+            _emit(out, {"ok": False, "error": f"bad JSON: {e}"})
+            return None
+        if not isinstance(req, dict):
+            # a bare string/number IS valid JSON — it must still be
+            # rejected cleanly, not crash the daemon on req.get
+            _emit(out, {"ok": False, "error": "request must be a JSON object"})
+            return None
+        return _handle(service, req, out)
+
+    while True:
+        # drain every pending command first (submits/cancels reshape the
+        # very next scheduling decision)
+        while shutdown is None:
+            try:
+                line = cmds.get_nowait()
+            except _q.Empty:
+                break
+            shutdown = process_line(line)
+        if shutdown == "now":
+            break
+        try:
+            worked = service.step()
+        except Exception as e:  # noqa: BLE001 — one job's crash must not kill the daemon
+            _emit(out, {
+                "event": "error", "error": f"{type(e).__name__}: {e}",
+            })
+            worked = None
+        for job in service.jobs.values():
+            if job.status in ("done", "failed") and job.job_id not in done_emitted:
+                done_emitted.add(job.job_id)
+                ev = {"event": job.status, "job": job.job_id}
+                if job.status == "done":
+                    r = job.result
+                    ev.update(rays=r.rays_traced,
+                              seconds=round(r.seconds, 3))
+                else:
+                    ev["error"] = job.error
+                _emit(out, ev)
+        if worked is None:
+            if shutdown == "drain" or eof.is_set():
+                break
+            # idle: block briefly for the next command and process it
+            # IN ORDER (re-queueing would reorder a burst of commands)
+            try:
+                shutdown = process_line(cmds.get(timeout=0.05))
+            except _q.Empty:
+                pass
+    return 0
+
+
+# --------------------------------------------------------------------------
+# --selftest: the CI smoke
+# --------------------------------------------------------------------------
+
+
+def selftest(args) -> int:
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from tpu_pbrt.scene.api import Options, compile_string
+    from tpu_pbrt.scenes import cornell_box_text
+
+    def say(msg):
+        print(f"serve-selftest: {msg}", file=sys.stderr)
+
+    text = cornell_box_text(res=64, spp=1, integrator="path", maxdepth=3)
+    crop = (0.0, 0.5, 0.0, 0.5)
+
+    # solo run-to-completion reference (its own compile + integrator —
+    # the service must reproduce it bit-for-bit through sliced,
+    # interleaved, preempted scheduling)
+    say("rendering solo reference")
+    scene, integ = compile_string(text, Options(quiet=True, crop_window=crop))
+    ref = np.asarray(integ.render(scene).image, np.float32)
+
+    args.chunk = args.chunk or 256
+    service = _make_service(args)
+    tmp = tempfile.mkdtemp(prefix="tpu_pbrt_selftest_")
+    preview_path = os.path.join(tmp, "preview.pfm")
+    opts = Options(quiet=True, crop_window=crop)
+    j1 = service.submit(text=text, options=opts, tenant="alice",
+                        preview_every=2, preview_path=preview_path)
+    j2 = service.submit(text=text, options=opts, tenant="bob")
+    say(f"submitted {j1} + {j2} (chunk={args.chunk})")
+
+    fails = []
+    res_stats = service.residency.stats()
+    if res_stats["scene_compiles"] != 1:
+        fails.append(
+            f"expected 1 scene compile for 2 same-scene submits, got "
+            f"{res_stats['scene_compiles']}"
+        )
+
+    # interleave a few slices, then preempt j2 mid-render
+    for _ in range(3):
+        service.step()
+    p2 = service.poll(j2)
+    service.preempt(j2)
+    say(f"preempted {j2} at chunk {service.poll(j2)['chunks_done']}")
+    if not (0 < p2["chunks_done"]):
+        fails.append(f"{j2} had no progress before preempt: {p2}")
+    for _ in range(2):
+        service.step()
+    service.resume(j2)
+    service.drain()
+
+    for j in (j1, j2):
+        r = service.result(j)
+        img = np.asarray(r.image, np.float32)
+        if not np.isfinite(img).all():
+            fails.append(f"{j}: non-finite pixels")
+        if img.shape != ref.shape or not np.array_equal(img, ref):
+            diff = (
+                float(np.max(np.abs(img - ref)))
+                if img.shape == ref.shape else "shape"
+            )
+            fails.append(f"{j}: film differs from solo (max diff {diff})")
+    if service.poll(j2)["preemptions"] < 1:
+        fails.append(f"{j2} records no preemption")
+    if service.poll(j1)["previews"] < 1 or not os.path.exists(preview_path):
+        fails.append("preview stream wrote no frames")
+
+    # warm resubmit: same scene again — zero scene compiles, zero jit
+    # recompiles (the _cache_size audit, PR 2)
+    ent = service.residency.get(
+        service.jobs[j1].resident_key
+    )
+    jfn = ent.integrator._jit_cache[1]
+    size_before = jfn._cache_size()
+    j3 = service.submit(text=text, options=opts, tenant="alice")
+    service.drain()
+    res_stats = service.residency.stats()
+    if res_stats["scene_compiles"] != 1:
+        fails.append(
+            f"warm resubmit recompiled the scene "
+            f"({res_stats['scene_compiles']} compiles)"
+        )
+    jfn2 = ent.integrator._jit_cache[1]
+    if jfn2 is not jfn or jfn2._cache_size() != size_before:
+        fails.append(
+            f"warm resubmit retraced the chunk closure "
+            f"({size_before} -> {jfn2._cache_size()})"
+        )
+    img3 = np.asarray(service.result(j3).image, np.float32)
+    if not np.array_equal(img3, ref):
+        fails.append("warm resubmit film differs from solo")
+
+    # cancel releases residency: a fresh job's pin, cancelled, unpins
+    j4 = service.submit(text=text, options=opts)
+    service.cancel(j4)
+    if service.residency.get(service.jobs[j4].resident_key).pins != 0:
+        fails.append("cancel left the residency pin held")
+
+    line = {
+        "selftest": "tpu_pbrt.serve",
+        "ok": not fails,
+        "jobs": len(service.jobs),
+        "schedule_len": len(service.schedule),
+        "scene_compiles": res_stats["scene_compiles"],
+        "residency_hits": res_stats["hits"],
+        "preemptions": service.poll(j2)["preemptions"],
+        "previews": service.poll(j1)["previews"],
+    }
+    if fails:
+        line["failures"] = fails
+        for f in fails:
+            say(f"FAIL: {f}")
+    print(json.dumps(line))
+    return 0 if not fails else 1
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    if args.selftest:
+        return selftest(args)
+    return run_daemon(_make_service(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

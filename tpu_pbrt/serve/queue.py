@@ -1,0 +1,142 @@
+"""Queue policy for the render service: priority classes + weighted
+fair sharing across tenants, deterministic given a seed.
+
+Two-level decision, evaluated at every scheduler step over the runnable
+job set:
+
+1. **Strict priority classes.** A higher `priority` int always schedules
+   before a lower one (and, through the service's `max_active` knob, can
+   PREEMPT a lower class's film residency — see
+   `preemption_victim`). Classes are for urgency tiers (interactive
+   preview vs batch final-frame), not for shares.
+2. **Weighted fair sharing across tenants** within a class: each tenant
+   carries a virtual service time (`vtime`) advanced by
+   `slice_cost / weight` per dispatched chunk-slice; the runnable job
+   whose tenant has the SMALLEST vtime runs next. A tenant with weight 2
+   therefore gets ~2x the slices of a weight-1 tenant under contention,
+   and an idle tenant re-enters at the current minimum among busy
+   tenants (no banked credit, the classic start-time fairness rule —
+   new tenants via `tenant()`, returning ones via `reenter()`, which
+   the service calls on every submit).
+3. FIFO within a tenant (submit sequence number).
+
+Determinism contract: `pick` consults nothing but (priority, vtime,
+seeded tenant hash, submit seq) — no wall clock, no dict order, no
+Python `hash` (PYTHONHASHSEED-dependent). Two services fed the same
+submit/charge sequence with the same seed produce the same interleaving,
+which is what lets tests assert interleaving-independence of the
+rendered films and replay a production schedule from its log.
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+from typing import Dict, Iterable, Optional
+
+
+@dataclass
+class TenantShare:
+    """Per-tenant fair-share accounting."""
+
+    weight: float = 1.0
+    vtime: float = 0.0  # virtual service time (slice cost / weight)
+    slices: int = 0  # total chunk-slices charged (stats only)
+
+
+class FairScheduler:
+    """Deterministic priority + weighted-fair-queueing policy."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._tenants: Dict[str, TenantShare] = {}
+
+    # -- tenants -----------------------------------------------------------
+    def tenant(self, name: str) -> TenantShare:
+        ts = self._tenants.get(name)
+        if ts is None:
+            # a new (or returning-idle) tenant starts at the current
+            # minimum vtime: it competes fairly from NOW instead of
+            # replaying every slice it never asked for
+            floor = min(
+                (t.vtime for t in self._tenants.values()), default=0.0
+            )
+            ts = self._tenants[name] = TenantShare(vtime=floor)
+        return ts
+
+    def set_weight(self, name: str, weight: float) -> None:
+        self.tenant(name).weight = max(float(weight), 1e-9)
+
+    def reenter(self, name: str, busy_tenants=()) -> None:
+        """Start-time fairness for a RETURNING tenant: clamp its vtime
+        up to the minimum among `busy_tenants` (the tenants that
+        currently have schedulable work — the caller knows the job
+        table, this policy object does not). Without the clamp an
+        existing tenant that went idle keeps its stale low vtime and
+        re-enters with banked credit, monopolizing the mesh until the
+        backlog 'catches up' — the exact opposite of the no-banked-
+        credit rule. Deterministic: a pure function of recorded
+        vtimes."""
+        ts = self.tenant(name)
+        floor = [
+            self._tenants[t].vtime
+            for t in busy_tenants
+            if t != name and t in self._tenants
+        ]
+        if floor:
+            ts.vtime = max(ts.vtime, min(floor))
+
+    def _tiebreak(self, tenant: str) -> int:
+        return zlib.crc32(f"{self.seed}:{tenant}".encode())
+
+    # -- policy ------------------------------------------------------------
+    def sort_key(self, job):
+        """Total order over runnable jobs: smaller runs first. `job`
+        needs .priority (int, higher = more urgent), .tenant (str) and
+        .seq (int submit sequence)."""
+        ts = self.tenant(job.tenant)
+        return (-job.priority, ts.vtime, self._tiebreak(job.tenant), job.seq)
+
+    def pick(self, jobs: Iterable):
+        """The runnable job to dispatch next, or None."""
+        best = None
+        best_key = None
+        for j in jobs:
+            k = self.sort_key(j)
+            if best is None or k < best_key:
+                best, best_key = j, k
+        return best
+
+    def charge(self, tenant: str, cost: float = 1.0) -> None:
+        """Account one dispatched chunk-slice to `tenant`."""
+        ts = self.tenant(tenant)
+        ts.vtime += cost / ts.weight
+        ts.slices += 1
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        return {
+            name: {
+                "weight": ts.weight,
+                "vtime": round(ts.vtime, 6),
+                "slices": ts.slices,
+            }
+            for name, ts in sorted(self._tenants.items())
+        }
+
+
+def preemption_victim(active_jobs: Iterable, candidate) -> Optional[object]:
+    """Which film-resident job to preempt (emergency-checkpoint to disk,
+    PR 5's path) so `candidate` can activate: the LOWEST-priority active
+    job strictly below the candidate's class — ties broken by largest
+    submit seq (newest first, oldest work is closest to done). None when
+    no active job is outranked (the candidate waits its fair turn
+    instead)."""
+    victim = None
+    v_key = None
+    for j in active_jobs:
+        if j.priority >= candidate.priority:
+            continue
+        k = (j.priority, -j.seq)
+        if victim is None or k < v_key:
+            victim, v_key = j, k
+    return victim

@@ -1,0 +1,235 @@
+"""Resident-scene cache: compiled scenes + their jit closures, LRU by
+HBM footprint.
+
+The paper's master keeps ONE scene loaded per worker process; a serving
+master multiplexing many renders must instead keep the HOT scenes
+resident — a scene compile (BVH build, material/texture baking,
+device upload) plus the first jit trace of its chunk program costs
+orders of magnitude more than rendering one chunk, so a repeat submit of
+a warm scene must pay ZERO of either. Residency here means three
+coupled things:
+
+- the `CompiledScene` (whose `dev` dict is the HBM-resident geometry /
+  material / texture tables),
+- the integrator instance bound to it — the single-slot jit-closure
+  cache (`WavefrontIntegrator._jit_cache`, the PR 2 `_cache_size` audit
+  contract) lives ON the integrator, so keeping the pair together is
+  what makes a warm resubmit report 0 jit recompiles,
+- the accounting to evict cold entries when the footprint budget is
+  exceeded (LRU by a monotonic touch counter — never wall clock, so
+  eviction order is deterministic and replayable).
+
+Entries are keyed by the scene SOURCE (file path + mtime/size, or a
+content hash for inline text): that key is known before compiling, which
+is what lets a hit skip the compile entirely. The render-config
+fingerprint (`parallel/checkpoint.render_fingerprint`) of every plan
+built against the entry is indexed alongside, so jobs, checkpoints and
+cache entries all speak the same identity.
+
+Pinning: a scene referenced by a live (queued/active/parked) job cannot
+be evicted — eviction only reclaims unpinned entries, and an over-budget
+cache of pinned scenes stays over budget (loudly, via stats) rather
+than corrupting a running job.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def scene_hbm_bytes(scene) -> int:
+    """Device-resident footprint of a compiled scene: every array leaf
+    of the `dev` pytree (geometry, BVH stream tables, materials,
+    texture atlas, light tables) plus one film-state allocation (the
+    accumulator a job of this scene will hold)."""
+    total = 0
+    for leaf in jax.tree.leaves(scene.dev):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            size = int(np.size(leaf))
+            nbytes = size * getattr(
+                getattr(leaf, "dtype", np.float32), "itemsize", 4
+            )
+        total += int(nbytes)
+    rx, ry = scene.film.full_resolution
+    total += rx * ry * 4 * (3 + 1 + 3)  # FilmState rgb + weight + splat
+    return total
+
+
+def scene_source_key(
+    path: Optional[str] = None, text: Optional[str] = None,
+    extra: Tuple = (),
+) -> str:
+    """Residency key computable BEFORE compiling: file identity
+    (abspath + mtime_ns + size — a rewritten file is a different scene)
+    or a content hash for inline text, plus `extra` (render-affecting
+    option overrides like crop/quick, which change the compiled film)."""
+    h = hashlib.sha1()
+    if path is not None:
+        p = os.path.abspath(path)
+        st = os.stat(p)
+        h.update(f"file:{p}:{st.st_mtime_ns}:{st.st_size}".encode())
+    elif text is not None:
+        h.update(b"text:")
+        h.update(text.encode())
+    else:
+        raise ValueError("scene_source_key needs a path or text")
+    for item in extra:
+        h.update(f":{item}".encode())
+    return h.hexdigest()[:16]
+
+
+@dataclass
+class ResidentScene:
+    """One cache entry: the compiled pair + accounting."""
+
+    key: str
+    scene: Any
+    integrator: Any
+    hbm_bytes: int
+    compile_seconds: float
+    pins: int = 0
+    last_used: int = 0  # monotonic touch counter (deterministic LRU)
+    hits: int = 0
+    #: render_fingerprints of plans built against this entry (grows as
+    #: jobs with different slice widths schedule on it)
+    fingerprints: set = field(default_factory=set)
+
+
+class ResidencyCache:
+    """LRU-by-HBM-footprint cache of ResidentScene entries."""
+
+    def __init__(self, max_bytes: Optional[int] = None):
+        self.max_bytes = max_bytes
+        self._entries: Dict[str, ResidentScene] = {}
+        self._clock = 0
+        self.scene_compiles = 0
+        self.hits = 0
+        self.evictions = 0
+
+    # -- core --------------------------------------------------------------
+    def _touch(self, ent: ResidentScene) -> None:
+        self._clock += 1
+        ent.last_used = self._clock
+
+    def get(self, key: str) -> Optional[ResidentScene]:
+        ent = self._entries.get(key)
+        if ent is not None:
+            self._touch(ent)
+        return ent
+
+    def get_or_compile(
+        self, key: str, builder: Callable[[], Tuple[Any, Any]],
+    ) -> ResidentScene:
+        """The submit path: a hit costs a dict lookup; a miss runs
+        `builder() -> (scene, integrator)` (parse + compile + upload),
+        inserts, and evicts cold unpinned entries past the budget."""
+        ent = self._entries.get(key)
+        if ent is not None:
+            ent.hits += 1
+            self.hits += 1
+            self._touch(ent)
+            return ent
+        import time
+
+        t0 = time.time()
+        scene, integ = builder()
+        self.scene_compiles += 1
+        ent = ResidentScene(
+            key=key, scene=scene, integrator=integ,
+            hbm_bytes=scene_hbm_bytes(scene),
+            compile_seconds=time.time() - t0,
+        )
+        self._entries[key] = ent
+        self._touch(ent)
+        # the entry being handed back must survive this call's eviction
+        # even when it alone exceeds the budget (the caller is about to
+        # pin and use it; evicting it here would dangle the reference)
+        ent.pins += 1
+        try:
+            self.evict_over_budget()
+        finally:
+            ent.pins -= 1
+        return ent
+
+    def find_by_fingerprint(self, fingerprint: str) -> Optional[ResidentScene]:
+        """Entry whose compiled plans include this render fingerprint
+        (`parallel/checkpoint.render_fingerprint`) — the lookup that
+        lets a checkpoint written by another process resume onto an
+        already-resident scene without recompiling."""
+        for ent in self._entries.values():
+            if fingerprint in ent.fingerprints:
+                self._touch(ent)
+                return ent
+        return None
+
+    # -- pinning / eviction ------------------------------------------------
+    def pin(self, key: str) -> None:
+        self._entries[key].pins += 1
+
+    def unpin(self, key: str) -> None:
+        ent = self._entries.get(key)
+        if ent is not None and ent.pins > 0:
+            ent.pins -= 1
+
+    def total_bytes(self) -> int:
+        return sum(e.hbm_bytes for e in self._entries.values())
+
+    def evict_over_budget(self) -> int:
+        """Evict least-recently-used UNPINNED entries until the total
+        footprint fits max_bytes (no-op when unbudgeted). Returns the
+        number of entries evicted. Dropping the entry releases the last
+        strong refs to scene.dev and the integrator's jit closure — jax
+        frees the device buffers when the arrays are collected."""
+        if self.max_bytes is None:
+            return 0
+        n = 0
+        while self.total_bytes() > self.max_bytes:
+            victims = [
+                e for e in self._entries.values() if e.pins == 0
+            ]
+            if not victims:
+                break  # everything pinned: stay over budget, loudly
+            coldest = min(victims, key=lambda e: e.last_used)
+            del self._entries[coldest.key]
+            self.evictions += 1
+            n += 1
+        return n
+
+    def release(self, key: str) -> bool:
+        """Drop an entry outright regardless of LRU order (explicit
+        invalidation); refuses while pinned. Returns whether dropped."""
+        ent = self._entries.get(key)
+        if ent is None or ent.pins > 0:
+            return False
+        del self._entries[key]
+        self.evictions += 1
+        return True
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "entries": len(self._entries),
+            "resident_bytes": self.total_bytes(),
+            "max_bytes": self.max_bytes,
+            "scene_compiles": self.scene_compiles,
+            "hits": self.hits,
+            "evictions": self.evictions,
+            "scenes": {
+                e.key: {
+                    "hbm_bytes": e.hbm_bytes,
+                    "pins": e.pins,
+                    "hits": e.hits,
+                    "compile_seconds": round(e.compile_seconds, 3),
+                }
+                for e in sorted(
+                    self._entries.values(), key=lambda e: -e.last_used
+                )
+            },
+        }

@@ -1,0 +1,782 @@
+"""tpu-serve session core: resumable render jobs multiplexed on one mesh.
+
+The paper's fork turns pbrt into a master/worker service — a master that
+owns a tile queue, workers that render on demand. PRs 1-5 reproduced the
+renderer as a batch CLI: compile one scene, drain one pool, exit. This
+module is the serving layer on top of the same machinery:
+
+- A **RenderJob** owns exactly the checkpoint-v4 tuple — film state,
+  chunk cursor, ray count, telemetry counter snapshot — plus a
+  `ChunkPlan` (integrators/common.py): the chunk decomposition and the
+  jitted dispatch closure the run-to-completion loop was refactored
+  around. Because every chunk is an idempotent pure function of
+  (scene, work range) and film accumulation is associative, a job can
+  be stopped between any two chunk-slices and resumed (same process or
+  another) with a film BIT-identical to an uninterrupted render.
+- The **scheduler loop** (`step`) dispatches ONE chunk-slice of one job
+  at a time. A slice is a bounded number of pool waves (the preemption
+  quantum): any job can be preempted at wave granularity with no lost
+  work, because the slice either completed (its deposits are in the
+  job's own film accumulator) or never ran.
+- **Preemption** parks a job through PR 5's emergency-checkpoint path:
+  the tuple is written durably (checkpoint v4 — CRC, fsync-before-
+  rename, `.prev` rotation), the in-memory film state is dropped (HBM
+  freed for higher-priority work), and a later activation reloads it.
+- **Residency** (serve/residency.py): compiled scenes + their jit
+  closures stay cached across jobs, so a warm resubmit pays zero scene
+  compiles and zero jit retraces (the PR 2 `_cache_size` audit is the
+  enforcement tool).
+- **Policy** (serve/queue.py): strict priority classes, weighted fair
+  sharing across tenants, deterministic given a seed — the recorded
+  `schedule` is replayable and tests assert films are independent of
+  the interleaving.
+- **Previews**: at a client-requested cadence the live film state is
+  developed (`film.develop` of the partial accumulator — radiance
+  planes self-normalize by the weight sum, so a partial render is a
+  noisier image, not a darker one) and written to PNG/EXR/PFM.
+
+Frontends: the library API here, `python -m tpu_pbrt.serve` (stdin/JSONL
+daemon + --selftest), and `tpu-pbrt --serve` (main.py).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from tpu_pbrt.config import cfg
+from tpu_pbrt.core.film import FilmState
+from tpu_pbrt.integrators.common import (
+    ChunkDispatchError,
+    ChunkPlan,
+    NonFiniteRadianceError,
+    NonFiniteWaveError,
+    RenderResult,
+    redispatch_backoff,
+)
+from tpu_pbrt.parallel.checkpoint import (
+    checkpoint_exists,
+    delete_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+from tpu_pbrt.serve.queue import FairScheduler, preemption_victim
+from tpu_pbrt.serve.residency import (
+    ResidencyCache,
+    scene_source_key,
+)
+
+# job lifecycle. queued: never dispatched. active: film state in memory.
+# parked: progress on disk (policy preemption), schedulable. paused:
+# explicitly preempted, needs resume(). done/cancelled/failed: terminal.
+QUEUED = "queued"
+ACTIVE = "active"
+PARKED = "parked"
+PAUSED = "paused"
+DONE = "done"
+CANCELLED = "cancelled"
+FAILED = "failed"
+_TERMINAL = (DONE, CANCELLED, FAILED)
+_RUNNABLE = (QUEUED, ACTIVE, PARKED)
+
+
+@dataclass
+class RenderJob:
+    """One submitted render: identity, policy inputs, and the resumable
+    state tuple (exactly what checkpoint v4 persists)."""
+
+    job_id: str
+    tenant: str
+    priority: int
+    seq: int  # submit sequence (FIFO within a tenant; the LRU tiebreak)
+    resident_key: str
+    chunk: Optional[int]  # slice width override (None = service default)
+    checkpoint_path: str
+    spool_ckpt: bool  # service-managed checkpoint (delete on terminal)
+    checkpoint_every: int
+    preview_every: int
+    preview_path: str
+    outfile: str
+    status: str = QUEUED
+    plan: Optional[ChunkPlan] = None
+    state: Optional[FilmState] = None
+    cursor: int = 0
+    prev_rays: int = 0
+    prev_ctr: Dict[str, Any] = field(default_factory=dict)
+    ray_counts: List[Any] = field(default_factory=list)
+    occ_counts: List[Any] = field(default_factory=list)
+    ctr_counts: List[Any] = field(default_factory=list)
+    nf_counts: List[Any] = field(default_factory=list)
+    attempt: int = 0
+    redispatches: int = 0
+    #: redispatches already folded into prev_ctr (by a park/checkpoint
+    #: write): snapshot_counters adds only the unbaked delta, or every
+    #: park would re-merge the cumulative count (render()'s prior_rec
+    #: double-count guard, ported)
+    baked_redispatches: int = 0
+    #: wall-clock deadline before which this job must not re-dispatch
+    #: (the capped-backoff window; other tenants schedule meanwhile)
+    not_before: float = 0.0
+    rollbacks: int = 0
+    restarts: int = 0
+    preemptions: int = 0
+    previews: int = 0
+    active_seconds: float = 0.0
+    error: str = ""
+    result: Optional[RenderResult] = None
+
+    # -- derived -----------------------------------------------------------
+    def progress(self) -> float:
+        if self.plan is None:
+            return 0.0
+        return self.cursor / max(self.plan.n_chunks, 1)
+
+    def rays_so_far(self) -> int:
+        return self.prev_rays + sum(
+            int(r) for r in jax.device_get(self.ray_counts)
+        )
+
+    def snapshot_counters(self) -> Dict[str, Any]:
+        """Cumulative telemetry counter dict — the checkpoint payload.
+        The device_get inside to_host is this job's drain-boundary
+        fetch (park/finalize ARE drain boundaries)."""
+        from tpu_pbrt.obs import counters as obs_counters
+
+        snap = obs_counters.merge_host(
+            self.prev_ctr, obs_counters.to_host(self.ctr_counts)
+        )
+        if self.nf_counts:
+            snap = obs_counters.merge_host(
+                snap,
+                {
+                    "nonfinite_deposits": sum(
+                        int(v) for v in jax.device_get(self.nf_counts)
+                    )
+                },
+            )
+        unbaked = self.redispatches - self.baked_redispatches
+        if unbaked > 0:
+            snap = obs_counters.merge_host(
+                snap, {"chunks_redispatched": unbaked}
+            )
+        return snap
+
+
+class RenderService:
+    """Multi-tenant render service over one device mesh.
+
+    Cooperative scheduler: `step()` dispatches exactly one chunk-slice
+    of the policy-selected job; `drain()` steps until every schedulable
+    job reaches a terminal state. All submits share `mesh` (None =
+    single device) — concurrency is wave-granular interleaving on the
+    shared mesh, not parallel processes, which is exactly the TPU
+    inference-stack shape (continuous batching on one resident model).
+
+    `max_active` bounds how many jobs may hold live film state (HBM) at
+    once; a higher-priority submit preempts the lowest outranked active
+    job through the emergency-checkpoint path when the bound is hit.
+    """
+
+    def __init__(
+        self,
+        mesh=None,
+        *,
+        chunk: Optional[int] = None,
+        max_resident_bytes: Optional[int] = None,
+        max_active: Optional[int] = None,
+        seed: int = 0,
+        spool_dir: Optional[str] = None,
+        quiet: bool = True,
+    ):
+        self.mesh = mesh
+        if chunk is None:
+            chunk = cfg.serve_chunk
+        self.chunk = chunk
+        if max_resident_bytes is None and cfg.serve_resident_mb is not None:
+            max_resident_bytes = int(cfg.serve_resident_mb * 1e6)
+        self.residency = ResidencyCache(max_bytes=max_resident_bytes)
+        self.scheduler = FairScheduler(seed=seed)
+        self.max_active = max_active
+        self.quiet = quiet
+        if spool_dir is None:
+            import tempfile
+
+            spool_dir = tempfile.mkdtemp(prefix="tpu_pbrt_serve_")
+        self.spool_dir = spool_dir
+        self.jobs: Dict[str, RenderJob] = {}
+        self._seq = 0
+        # strict non-finite firewall modes read the scrub COUNT, which
+        # rides the telemetry counters: refuse the combination here like
+        # render() does, instead of silently degrading every job to
+        # scrub mode (the exact contamination raise/retry exist to stop)
+        from tpu_pbrt.obs import counters as obs_counters
+
+        if cfg.nonfinite != "scrub" and not obs_counters.enabled():
+            raise ValueError(
+                f"TPU_PBRT_NONFINITE={cfg.nonfinite} needs the telemetry "
+                "counters (the firewall's scrub count), but "
+                "TPU_PBRT_TELEMETRY=0 disabled them; re-enable telemetry "
+                "or use the default scrub mode"
+            )
+        #: the dispatch record [(job_id, chunk_index), ...] — the
+        #: deterministic-interleaving evidence tests assert on
+        self.schedule: List[tuple] = []
+
+    # -- submit ------------------------------------------------------------
+    def submit(
+        self,
+        path: Optional[str] = None,
+        *,
+        text: Optional[str] = None,
+        compiled=None,
+        resident_key: Optional[str] = None,
+        options=None,
+        job_id: Optional[str] = None,
+        tenant: str = "default",
+        priority: int = 0,
+        weight: Optional[float] = None,
+        chunk: Optional[int] = None,
+        checkpoint_path: str = "",
+        checkpoint_every: int = 0,
+        preview_every: int = 0,
+        preview_path: str = "",
+        outfile: str = "",
+    ) -> str:
+        """Submit a render: a .pbrt file `path`, inline scene `text`, or
+        a precompiled (scene, integrator) pair. Returns the job id.
+        Scene compilation happens HERE (once per resident key — a warm
+        key is a cache hit); no rendering happens until `step`."""
+        from tpu_pbrt.obs.trace import TRACE
+
+        if options is None:
+            from tpu_pbrt.scene.api import Options
+
+            options = Options(quiet=self.quiet)
+        opt_extra = (
+            getattr(options, "crop_window", None),
+            getattr(options, "quick_render", False),
+            getattr(options, "image_file", ""),
+        )
+        if compiled is not None:
+            scene_obj = compiled[0]
+            key = resident_key or f"obj:{id(scene_obj):x}"
+            builder = lambda: compiled  # noqa: E731
+        elif path is not None:
+            key = resident_key or scene_source_key(path=path, extra=opt_extra)
+
+            def builder():
+                from tpu_pbrt.scene.api import compile_file
+
+                return compile_file(path, options)
+
+        elif text is not None:
+            key = resident_key or scene_source_key(text=text, extra=opt_extra)
+
+            def builder():
+                from tpu_pbrt.scene.api import compile_string
+
+                return compile_string(text, options)
+
+        else:
+            raise ValueError("submit needs a path, text, or compiled pair")
+
+        with TRACE.span("serve/submit", key=key):
+            ent = self.residency.get_or_compile(key, builder)
+        from tpu_pbrt.integrators.common import WavefrontIntegrator
+
+        if type(ent.integrator).render is not WavefrontIntegrator.render:
+            # SPPM/MLT own their render loops (camera/photon passes,
+            # bootstrap chains) — they have no chunk-plan seam yet, so a
+            # sliced submit would trace li() that does not exist. Refuse
+            # at submit time with a clear error instead of failing the
+            # first dispatch.
+            name = getattr(ent.integrator, "name", type(ent.integrator).__name__)
+            raise ValueError(
+                f"integrator {name!r} overrides the chunked render loop "
+                "and cannot be served slice-wise; render it with the "
+                "batch CLI"
+            )
+        self.residency.pin(key)
+
+        self._seq += 1
+        if job_id is None:
+            job_id = f"j{self._seq}"
+        if job_id in self.jobs:
+            self.residency.unpin(key)
+            raise ValueError(f"job id {job_id!r} already exists")
+        spool_ckpt = not checkpoint_path
+        if spool_ckpt:
+            checkpoint_path = os.path.join(
+                self.spool_dir, f"{job_id}.ckpt.npz"
+            )
+        job = RenderJob(
+            job_id=job_id, tenant=tenant, priority=int(priority),
+            seq=self._seq, resident_key=key,
+            chunk=chunk if chunk is not None else self.chunk,
+            checkpoint_path=checkpoint_path, spool_ckpt=spool_ckpt,
+            checkpoint_every=int(checkpoint_every),
+            preview_every=int(preview_every), preview_path=preview_path,
+            outfile=outfile,
+        )
+        if weight is not None:
+            self.scheduler.set_weight(tenant, weight)
+        # start-time fairness: a tenant returning from idle re-enters at
+        # the busy tenants' vtime floor instead of spending banked credit
+        self.scheduler.reenter(
+            tenant,
+            busy_tenants={
+                j.tenant for j in self.jobs.values()
+                if j.status in _RUNNABLE
+            },
+        )
+        self.jobs[job_id] = job
+        self._flight(job, "serve_submit", key=key, tenant=tenant,
+                     priority=job.priority)
+        return job_id
+
+    # -- the scheduler step -------------------------------------------------
+    def _runnable(self) -> List[RenderJob]:
+        active = [j for j in self.jobs.values() if j.state is not None]
+        out = []
+        now = time.time()
+        for j in self.jobs.values():
+            if j.status not in _RUNNABLE:
+                continue
+            if j.not_before > now:
+                continue  # inside its re-dispatch backoff window
+            if j.state is None and self.max_active is not None and len(
+                active
+            ) >= self.max_active:
+                # activating this job needs a film-state slot: runnable
+                # only if it outranks someone it could preempt
+                if preemption_victim(active, j) is None:
+                    continue
+            out.append(j)
+        return out
+
+    def step(self) -> Optional[str]:
+        """Dispatch ONE chunk-slice of the policy-selected job. Returns
+        that job's id, or None when nothing is schedulable (all jobs
+        terminal, paused, or blocked on residency)."""
+        job = self.scheduler.pick(self._runnable())
+        if job is None:
+            # nothing dispatchable — but a job whose backoff window is
+            # still open is WORK, not idleness: wait out the earliest
+            # deadline so drain() doesn't return with jobs unfinished
+            waiting = [
+                j.not_before for j in self.jobs.values()
+                if j.status in _RUNNABLE and j.not_before > time.time()
+            ]
+            if waiting:
+                time.sleep(max(min(waiting) - time.time(), 0.0))
+                job = self.scheduler.pick(self._runnable())
+            if job is None:
+                return None
+        try:
+            self._activate(job)
+            self._dispatch_slice(job)
+        except Exception as e:  # noqa: BLE001
+            # an unexpected error (trace failure, OOM, corrupt resume)
+            # fails THE JOB, not the service — other tenants keep
+            # rendering. The dispatch-level recovery ladder inside
+            # _dispatch_slice already handled the expected failures.
+            if job.status not in _TERMINAL:
+                job.status = FAILED
+                job.error = job.error or f"{type(e).__name__}: {e}"
+            job.state = None
+            self.residency.unpin(job.resident_key)
+            self._flight(job, "serve_failed", error=str(job.error)[:200])
+        return job.job_id
+
+    def drain(self, max_steps: int = 1_000_000) -> None:
+        """Step until no job is schedulable (paused jobs stay parked)."""
+        for _ in range(max_steps):
+            if self.step() is None:
+                return
+        raise RuntimeError("drain exceeded max_steps — scheduler wedged?")
+
+    def idle(self) -> bool:
+        return all(
+            j.status in _TERMINAL or j.status == PAUSED
+            for j in self.jobs.values()
+        )
+
+    # -- lifecycle verbs -----------------------------------------------------
+    def preempt(self, job_id: str) -> None:
+        """Explicit wave-granular preemption: emergency-checkpoint the
+        job's tuple (PR 5's durable write path), free its film state,
+        and PARK it until resume(). A job between slices loses nothing
+        — the checkpoint is the exact (state, cursor, rays, counters)
+        the next activation reloads."""
+        job = self._job(job_id)
+        if job.status in _TERMINAL:
+            raise ValueError(f"job {job_id} is {job.status}")
+        if job.state is not None:
+            self._park(job)
+        job.status = PAUSED
+        self._flight(job, "serve_preempt", chunk=job.cursor)
+
+    def resume(self, job_id: str) -> None:
+        job = self._job(job_id)
+        if job.status != PAUSED:
+            raise ValueError(f"job {job_id} is {job.status}, not paused")
+        job.status = PARKED if job.cursor else QUEUED
+        self._flight(job, "serve_resume", chunk=job.cursor)
+
+    def cancel(self, job_id: str) -> None:
+        """Terminal cancel: frees the film state, releases the residency
+        pin (an unpinned scene is evictable), and removes the
+        service-managed checkpoint spool."""
+        job = self._job(job_id)
+        if job.status in _TERMINAL:
+            return
+        job.status = CANCELLED
+        job.state = None
+        job.plan = None
+        self.residency.unpin(job.resident_key)
+        self.residency.evict_over_budget()
+        if job.spool_ckpt:
+            delete_checkpoint(job.checkpoint_path)
+        self._flight(job, "serve_cancel", chunk=job.cursor)
+
+    def poll(self, job_id: str) -> Dict[str, Any]:
+        job = self._job(job_id)
+        out = {
+            "job": job.job_id,
+            "status": job.status,
+            "tenant": job.tenant,
+            "priority": job.priority,
+            "progress": round(job.progress(), 6),
+            "chunks_done": job.cursor,
+            "chunks_total": job.plan.n_chunks if job.plan else None,
+            "preemptions": job.preemptions,
+            "redispatches": job.redispatches,
+            "previews": job.previews,
+        }
+        if job.error:
+            out["error"] = job.error
+        return out
+
+    def result(self, job_id: str) -> RenderResult:
+        job = self._job(job_id)
+        if job.status != DONE or job.result is None:
+            raise ValueError(
+                f"job {job_id} has no result (status {job.status}"
+                + (f": {job.error}" if job.error else "") + ")"
+            )
+        return job.result
+
+    def preview(self, job_id: str) -> np.ndarray:
+        """Develop the job's LIVE film state to an image right now (the
+        streaming-preview primitive; the cadence path calls this too)."""
+        job = self._job(job_id)
+        if job.result is not None:
+            return job.result.image
+        plan, state = job.plan, job.state
+        if plan is None or state is None:
+            raise ValueError(f"job {job_id} has no live film state")
+        frac = max(job.progress(), 1e-9)
+        return plan.film.develop(state, splat_scale=1.0 / (plan.spp * frac))
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "jobs": {j.job_id: self.poll(j.job_id) for j in self.jobs.values()},
+            "residency": self.residency.stats(),
+            "tenants": self.scheduler.stats(),
+            "schedule_len": len(self.schedule),
+        }
+
+    # -- internals -----------------------------------------------------------
+    def _job(self, job_id: str) -> RenderJob:
+        job = self.jobs.get(job_id)
+        if job is None:
+            raise KeyError(f"unknown job {job_id!r}")
+        return job
+
+    def _flight(self, job: RenderJob, phase: str, **fields) -> None:
+        """Heartbeat into a PER-JOB flight file: the recorder is re-armed
+        with a job-keyed path around each write so concurrent jobs never
+        interleave into one stream (the BENCH_flight.jsonl collision)."""
+        from tpu_pbrt.obs.flight import FLIGHT, job_flight_path
+
+        base = FLIGHT.path
+        if not base:
+            FLIGHT.heartbeat(phase, job=job.job_id, **fields)
+            return
+        orig = FLIGHT._path
+        try:
+            FLIGHT.configure(job_flight_path(base, job.job_id))
+            FLIGHT.heartbeat(phase, job=job.job_id, **fields)
+        finally:
+            FLIGHT.configure(orig)
+
+    def _activate(self, job: RenderJob) -> None:
+        """Make the job dispatchable: build (or re-use) its ChunkPlan,
+        then load its film state — fresh, or from its checkpoint when a
+        preemption parked it. Evicts/preempts per policy first."""
+        if job.state is not None:
+            job.status = ACTIVE
+            return
+        if self.max_active is not None:
+            active = [j for j in self.jobs.values() if j.state is not None]
+            while len(active) >= self.max_active:
+                victim = preemption_victim(active, job)
+                if victim is None:
+                    break
+                self._park(victim)
+                victim.status = PARKED
+                active = [
+                    j for j in self.jobs.values() if j.state is not None
+                ]
+        ent = self.residency.get(job.resident_key)
+        if ent is None:  # evicted while queued (unpinned by a bug) —
+            raise RuntimeError(
+                f"resident scene for job {job.job_id} was evicted while "
+                "the job still held a pin"
+            )
+        if job.plan is None:
+            job.plan = ent.integrator.prepare_chunks(
+                ent.scene, self.mesh, chunk=job.chunk
+            )
+            ent.fingerprints.add(job.plan.fingerprint)
+            job.plan.capacity_audit()
+        if checkpoint_exists(job.checkpoint_path):
+            state, cursor, rays, ctr = load_checkpoint(
+                job.checkpoint_path, job.plan.fingerprint
+            )
+            job.state, job.cursor, job.prev_rays, job.prev_ctr = (
+                state, cursor, rays, ctr
+            )
+            job.ray_counts.clear()
+            job.occ_counts.clear()
+            job.ctr_counts.clear()
+            job.nf_counts.clear()
+        else:
+            job.state = job.plan.film.init_state()
+        job.status = ACTIVE
+
+    def _park(self, job: RenderJob) -> None:
+        """Emergency-checkpoint the tuple and drop the film state (the
+        preemption write — PR 5's durable path: CRC + fsync + .prev)."""
+        from tpu_pbrt.obs.trace import TRACE
+
+        with TRACE.span("serve/park", job=job.job_id, chunk=job.cursor):
+            save_checkpoint(
+                job.checkpoint_path, job.state, job.cursor,
+                job.rays_so_far(), fingerprint=job.plan.fingerprint,
+                counters=job.snapshot_counters(),
+            )
+        job.prev_rays = job.rays_so_far()
+        job.prev_ctr = job.snapshot_counters()
+        job.baked_redispatches = job.redispatches
+        job.ray_counts.clear()
+        job.occ_counts.clear()
+        job.ctr_counts.clear()
+        job.nf_counts.clear()
+        job.state = None
+        job.preemptions += 1
+        self._flight(job, "serve_park", chunk=job.cursor)
+
+    def _dispatch_slice(self, job: RenderJob) -> None:
+        """One chunk-slice with the recovery ladder (capped-backoff
+        re-dispatch; poisoning failures roll back to the job's last
+        checkpoint or restart the job)."""
+        from tpu_pbrt.chaos import CHAOS
+        from tpu_pbrt.obs.trace import TRACE
+
+        plan = job.plan
+        c = job.cursor
+        t0 = time.time()
+        try:
+            CHAOS.dispatch(c, job.attempt, mesh=self.mesh is not None)
+            try:
+                with TRACE.span(
+                    "serve/slice", job=job.job_id, chunk=c,
+                ):
+                    state, aux = plan.dispatch(job.state, c)
+            except jax.errors.JaxRuntimeError as e:
+                job.state = None  # the donated accumulator is untrusted
+                raise ChunkDispatchError(
+                    f"device dispatch failed: {e}", poisons_state=True
+                ) from e
+            if cfg.nonfinite != "scrub":
+                nrays, occ, ctr, _, nf = plan.aux_parts(aux)
+                nf_dev = ctr.nonfinite if ctr is not None else nf
+                nf_ct = 0 if nf_dev is None else int(jax.device_get(nf_dev))
+                if nf_ct:
+                    if cfg.nonfinite == "raise":
+                        job.status = FAILED
+                        job.error = (
+                            f"chunk {c} deposited {nf_ct} non-finite "
+                            "sample(s) (TPU_PBRT_NONFINITE=raise)"
+                        )
+                        raise NonFiniteRadianceError(job.error)
+                    job.state = state  # retry: treat as poisoned
+                    raise NonFiniteWaveError(
+                        f"non-finite firewall: chunk {c} scrubbed "
+                        f"{nf_ct} deposit(s)"
+                    )
+        except ChunkDispatchError as e:
+            self._recover(job, e)
+            return
+        job.attempt = 0
+        job.state = state
+        job.cursor = c + 1
+        job.active_seconds += time.time() - t0
+        self.schedule.append((job.job_id, c))
+        self.scheduler.charge(job.tenant)
+        nrays, occ, ctr, spread, nf = plan.aux_parts(aux)
+        job.ray_counts.append(nrays)
+        if occ is not None:
+            job.occ_counts.append(occ)
+        if ctr is not None:
+            job.ctr_counts.append(ctr)
+        if nf is not None:
+            job.nf_counts.append(nf)
+        if job.checkpoint_every and job.cursor % job.checkpoint_every == 0:
+            save_checkpoint(
+                job.checkpoint_path, job.state, job.cursor,
+                job.rays_so_far(), fingerprint=plan.fingerprint,
+                counters=job.snapshot_counters(),
+            )
+        if (
+            job.preview_every
+            and job.preview_path
+            and job.cursor % job.preview_every == 0
+            and job.cursor < plan.n_chunks
+        ):
+            self._write_preview(job)
+        if job.cursor >= plan.n_chunks:
+            self._finalize(job)
+
+    def _recover(self, job: RenderJob, e: ChunkDispatchError) -> None:
+        job.attempt += 1
+        job.redispatches += 1
+        if job.attempt > int(cfg.retry_max):
+            if job.state is not None and not e.poisons_state:
+                self._park(job)  # completed work survives the failure
+            job.status = FAILED
+            job.error = f"chunk {job.cursor} failed {job.attempt} times: {e}"
+            job.state = None
+            self.residency.unpin(job.resident_key)
+            self._flight(job, "serve_failed", error=job.error[:200])
+            return
+        if e.poisons_state:
+            job.state = None
+            if checkpoint_exists(job.checkpoint_path):
+                job.rollbacks += 1
+            else:
+                # no durable progress: restart this job from chunk 0
+                job.cursor = 0
+                job.prev_rays = 0
+                job.prev_ctr = {}
+                job.baked_redispatches = 0
+                job.restarts += 1
+            job.ray_counts.clear()
+            job.occ_counts.clear()
+            job.ctr_counts.clear()
+            job.nf_counts.clear()
+            job.status = PARKED  # re-activation reloads/re-inits state
+        backoff = redispatch_backoff(job.cursor, job.attempt)
+        self._flight(
+            job, "serve_redispatch", chunk=job.cursor,
+            attempt=job.attempt, poisoned=e.poisons_state,
+            backoff_s=round(backoff, 3), error=str(e)[:200],
+        )
+        # the backoff is a per-job NOT-BEFORE deadline, never a sleep on
+        # the scheduler thread: other tenants' healthy jobs keep
+        # dispatching through one job's retry streak (step() only waits
+        # when EVERY runnable job is inside its backoff window)
+        if backoff > 0:
+            job.not_before = time.time() + backoff
+
+    def _write_preview(self, job: RenderJob) -> None:
+        from tpu_pbrt.obs.trace import TRACE
+        from tpu_pbrt.utils import imageio
+
+        with TRACE.span("serve/preview", job=job.job_id, chunk=job.cursor):
+            img = self.preview(job.job_id)
+            try:
+                imageio.write_image(job.preview_path, img)
+                job.previews += 1
+            except Exception as ex:  # noqa: BLE001
+                from tpu_pbrt.utils.error import Warning as _W
+
+                _W(f"preview write failed for {job.job_id}: {ex}")
+        self._flight(job, "serve_preview", chunk=job.cursor)
+
+    def _finalize(self, job: RenderJob) -> None:
+        from tpu_pbrt.obs import counters as obs_counters
+        from tpu_pbrt.obs.trace import TRACE
+
+        plan = job.plan
+        with TRACE.span("serve/finalize", job=job.job_id):
+            jax.block_until_ready(job.state)
+            rays = job.rays_so_far()
+            ctr_total = job.snapshot_counters()
+            stats: Dict[str, Any] = {
+                "job_id": job.job_id,
+                "tenant": job.tenant,
+                "preemptions": job.preemptions,
+            }
+            if job.redispatches:
+                stats["recovery"] = {
+                    "redispatches": job.redispatches,
+                    "rollbacks": job.rollbacks,
+                    "restarts": job.restarts,
+                }
+            if plan.use_regen and job.occ_counts:
+                occ_host = jax.device_get(job.occ_counts)
+                lv = sum(int(a) for a, _, _ in occ_host)
+                wv = sum(int(b) for _, b, _ in occ_host)
+                tr = sum(int(t) for _, _, t in occ_host)
+                if tr:
+                    from tpu_pbrt.utils.error import Warning as _W
+
+                    _W(
+                        f"job {job.job_id}: pool drain truncated {tr} "
+                        "chunk(s) at the max_waves bound — the image is "
+                        "missing samples"
+                    )
+                    stats["truncated_chunks"] = tr
+                stats |= {
+                    "mean_wave_occupancy": lv / max(wv * plan.pool, 1),
+                    "n_waves": wv,
+                    "pool": plan.pool,
+                    "regen": True,
+                }
+            if obs_counters.enabled() and ctr_total:
+                stats["telemetry"] = {"counters": ctr_total}
+            img = plan.film.develop(job.state, splat_scale=1.0 / plan.spp)
+            if job.outfile:
+                from tpu_pbrt.utils import imageio
+
+                try:
+                    imageio.write_image(job.outfile, img)
+                except Exception as ex:  # noqa: BLE001
+                    from tpu_pbrt.utils.error import Warning as _W
+
+                    _W(f"could not write {job.outfile}: {ex}")
+        job.result = RenderResult(
+            image=img,
+            film_state=job.state,
+            seconds=job.active_seconds,
+            rays_traced=rays,
+            mray_per_sec=rays / max(job.active_seconds, 1e-9) / 1e6,
+            spp=plan.spp,
+            completed_fraction=1.0,
+            stats=stats,
+        )
+        job.status = DONE
+        job.state = None  # the film lives on in result.film_state
+        self.residency.unpin(job.resident_key)
+        self.residency.evict_over_budget()
+        if job.spool_ckpt:
+            delete_checkpoint(job.checkpoint_path)
+        self._flight(job, "serve_done", rays=rays,
+                     seconds=round(job.active_seconds, 3))
