@@ -344,6 +344,7 @@ def main():
             # tpu_pbrt, see above)
             line["telemetry"] = {
                 "counters": None, "wave_spread": None,
+                "tracer_mode": None, "fused_blocks_per_flush": None,
                 "live_bytes_per_sec": None, "live_flops_per_sec": None,
                 "hbm_peak_bytes_per_sec": None,
                 "live_vs_static_ratio": None,
@@ -533,9 +534,23 @@ def main():
 
     tstats = result.stats.get("telemetry") or {}
     devs = _jax.devices()
+    # tracer attribution (ISSUE 9): which flush/expand program the wave
+    # compiled to, and the static per-flush block capacity of the fused
+    # grid — so the live roofline ratio reads against the right kernel
+    fused_blocks = None
+    if result.stats.get("pool") and "tstream" in scene.dev:
+        from tpu_pbrt.accel.stream import flush_geometry
+
+        fused_blocks = flush_geometry(
+            # the tracer sees the fused camera+shadow 2R wave
+            2 * int(result.stats["pool"]),
+            scene.dev["tstream"].n_treelets,
+        )["blocks_per_flush"]
     _last_line["telemetry"] = {
         "counters": tstats.get("counters"),
         "wave_spread": tstats.get("wave_spread"),
+        "tracer_mode": result.stats.get("tracer_mode"),
+        "fused_blocks_per_flush": fused_blocks,
         **live_vs_static(
             waves=result.stats.get("n_waves"),
             seconds=result.seconds,

@@ -53,6 +53,17 @@ python -m tpu_pbrt.obs "$SMOKE_DIR/trace.json" \
     --flight "$SMOKE_DIR/flight.jsonl" \
     --require-phases render,render_done,develop --min-spans 3
 
+# fused-kernel interpret-mode smoke (ISSUE 9): render a small scene
+# with TPU_PBRT_FUSED=1 (Pallas wavefront kernels, interpret mode on
+# CPU) and bit-compare against the jnp path, through a mid-render
+# dispatch fault so the recovery ladder runs over the fused program.
+# Implemented as the chaos matrix's fused-tracer row; running it alone
+# first gives a fast, named failure before the full matrix below. The
+# row uses a killeroo-like scene, not cornell: cornell compiles to the
+# brute MXU path and never touches the stream tracer being swapped.
+echo "== fused wavefront kernel smoke (python -m tpu_pbrt.chaos --only fused-tracer)"
+python -m tpu_pbrt.chaos --only fused-tracer
+
 # chaos recovery matrix (ISSUE 5): every fault scenario — poisoned/clean
 # dispatch loss, torn/crashed/bit-flipped checkpoint writes, corrupt
 # checkpoint resume, NaN wave, retry-budget exhaustion, mesh device

@@ -81,6 +81,14 @@ packet walk (accel/treelet.py) with fatter leaves (STREAM_LEAF_TRIS):
 the MXU makes triangle tests nearly free, so trading deeper trees for
 fatter matmuls moves work from the latency-bound worklist to the
 compute units.
+
+TPU_PBRT_FUSED selects between two compilations of the SAME algorithm
+(bit-identical by contract): the jnp path above, and the fused Pallas
+wavefront kernels (accel/fusedwave.py) that run each flush chunk and
+each expansion's dense middle as one grid with the ray tables, winner
+accumulators and node table VMEM-resident — only the sort-based
+compactions stay at jnp level. See _use_fused / the fusedwave module
+doc for the gates and the VMEM budget math.
 """
 
 from __future__ import annotations
@@ -119,20 +127,66 @@ _ONEHOT_MAX_NODES = 512
 _I32_MAX = np.int32(2**31 - 1)
 
 
-def _use_pallas() -> bool:
-    """Static (trace-time) switch: the fused Pallas leaf kernel runs on
-    real TPUs; CPU (tests, virtual meshes) uses the XLA einsum fallback.
-    TPU_PBRT_PALLAS=0 forces the fallback for A/B comparison."""
+def _use_fused(R: int) -> bool:
+    """Static (trace-time) switch for the fused Pallas wavefront kernels
+    (accel/fusedwave.py): TPU_PBRT_FUSED=1 forces them on (interpret
+    mode on CPU — the testing story), =0 forces the jnp path, unset
+    means auto (on for TPU backends). TPU_PBRT_PALLAS=0 remains the
+    global escape hatch. Waves past TPU_PBRT_FUSED_MAX_RAYS fall back
+    to the jnp path: the fused kernels keep the (8, R) ray table and
+    the (R,) winner accumulators VMEM-resident (budget math in the
+    fusedwave module doc / README)."""
     if not cfg.pallas:
         return False
-    return jax.default_backend() not in ("cpu",)
+    f = cfg.fused
+    if f is None:
+        f = jax.default_backend() not in ("cpu",)
+    if not f:
+        return False
+    return R <= int(cfg.fused_max_rays)
 
 
-def _use_prefetch() -> bool:
-    """Opt-in scalar-prefetch leaf kernel (TPU_PBRT_PREFETCH=1): DMAs
-    treelet rows in-kernel instead of a materialized gather. Verified
-    bit-compatible; currently ~15% slower end-to-end (see _flush)."""
-    return cfg.prefetch
+def _fused_interpret() -> bool:
+    """Pallas interpret mode off-TPU: same sequential grid semantics,
+    pure-XLA execution — how tier-1 tests and the chaos matrix exercise
+    the fused kernels on CPU."""
+    return jax.default_backend() in ("cpu",)
+
+
+def tracer_mode(R: int = 1 << 16) -> str:
+    """Static tracer attribution for telemetry/bench: which leaf/flush
+    path a wave of R rays would compile to ('fused' | 'jnp')."""
+    return "fused" if _use_fused(R) else "jnp"
+
+
+def flush_geometry(R: int, n_treelets: int) -> dict:
+    """Static flush-phase shape for a wave of R rays: worklist sizes
+    and the per-flush block capacity (bench.py records
+    blocks_per_flush as `fused_blocks_per_flush` so live captures can
+    attribute the roofline ratio to the right kernel)."""
+    slab, w, lb = _sizes(R)
+    b_cap = lb // BLOCK + n_treelets + 2
+    return {
+        "slab": slab,
+        "worklist": w,
+        "leaf_buffer": lb,
+        "blocks_per_flush": b_cap,
+        "chunk": min(CHUNK, b_cap),
+        "tracer_mode": tracer_mode(R),
+    }
+
+
+def clear_traverse_caches() -> None:
+    """Drop the jit caches of every module-level traversal entry point.
+
+    These cache by aval shape alone, so any trace-time mode flip with
+    unchanged shapes (a TPU_PBRT_FUSED reload, audit's forced_tracer,
+    tests flipping knobs) MUST call this or a later trace — even from a
+    brand-new integrator — inlines a stale inner jaxpr. One definition
+    here so stage two adding an entry point updates every caller."""
+    for f in (stream_intersect, stream_intersect_split, _traverse_p,
+              stream_traverse_stats):
+        f.clear_cache()
 
 
 def _use_onehot(n_nodes: int) -> bool:
@@ -249,7 +303,8 @@ def _fetch_children(tab64, boxT, cidT, node, use_onehot: bool):
 
 
 def _expand(tp: TreeletPack, tab64, boxT, cidT, s: _SState, slab: int,
-            w: int, lb: int, any_hit: bool, use_onehot: bool):
+            w: int, lb: int, any_hit: bool, use_onehot: bool,
+            use_fused: bool = False):
     R = s.rayE.shape[1]
     rb = _ray_bits(R)
     tb = _tn_bits(R)
@@ -260,6 +315,36 @@ def _expand(tp: TreeletPack, tab64, boxT, cidT, s: _SState, slab: int,
         valid, jax.lax.dynamic_slice(s.stk_key, (start,), (slab,)), _I32_MAX
     )
     node = jnp.where(valid, jax.lax.dynamic_slice(s.stk_code, (start,), (slab,)), 0)
+    if use_fused:
+        # the dense middle of the expansion — ray fetch, child fetch,
+        # slab tests, push-key build — runs as ONE Pallas grid with the
+        # popped slab and the node table resident in VMEM
+        # (accel/fusedwave.py; bit-identical by construction). Only the
+        # (8, S) key/candidate planes come back to HBM for the
+        # compaction sort below — lax.sort stays at jnp level, where
+        # the int-key radix fast path lives. The kernel may pad S up to
+        # its grid tile; pad lanes are dead keys the sort drops.
+        from tpu_pbrt.accel.fusedwave import fused_expand
+
+        key8, cand8, live_i = fused_expand(
+            key_in, node, s.rayE, s.prim,
+            tab64 if use_onehot else None,
+            None if use_onehot else boxT.reshape(48, -1),
+            None if use_onehot else cidT,
+            tb=tb, use_onehot=use_onehot, any_hit=any_hit,
+            interpret=_fused_interpret(),
+        )
+        key = key8.reshape(-1)
+        cand_code = cand8.reshape(-1)
+        n_leaf = jnp.sum(key < (1 << 30), dtype=jnp.int32)
+        n_int = jnp.sum(
+            (key >= (1 << 30)) & (key != _I32_MAX), dtype=jnp.int32
+        )
+        key_s, code_s = jax.lax.sort([key, cand_code], num_keys=1)
+        s8 = 8 * slab
+        return _expand_push(
+            s, key_s, code_s, n_leaf, n_int, live_i, start, w, lb, s8
+        )
     # stack entries are always interiors: ray id sits at key bits
     # [tb, tb+rb); the low tb bits hold the complemented quantized entry
     # distance, reconstructed here by zero-filling the mantissa tail —
@@ -319,6 +404,20 @@ def _expand(tp: TreeletPack, tab64, boxT, cidT, s: _SState, slab: int,
     n_leaf = jnp.sum(is_leaf, dtype=jnp.int32)
     n_int = jnp.sum(is_int, dtype=jnp.int32)
     s8 = 8 * slab
+    return _expand_push(
+        s, key_s, code_s, n_leaf, n_int, live, start, w, lb, s8
+    )
+
+
+def _expand_push(s: _SState, key_s, code_s, n_leaf, n_int, live,
+                 start, w: int, lb: int, s8: int):
+    """Shared tail of EXPAND (jnp and fused front halves): append the
+    sorted leaf prefix to the leaf buffer, push the interior span onto
+    the stack, roll the counters. `live` is the per-pair live mask
+    (jnp: (S,) bool; fused: the kernel's (Sp,) i32 row) — summed HERE,
+    after the buffer writes, so the jnp program's equation order (and
+    with it the persistent-compile-cache hash of every render program)
+    is byte-identical to the pre-fusedwave trace."""
 
     # append the leaf prefix to the leaf buffer (contiguous write; for
     # leaves the sort key IS the ray id). Garbage entries past n_leaf
@@ -410,11 +509,8 @@ def _flush(tp: TreeletPack, featT_tab, s: _SState, lb: int,
     lb_v = min(lb, s.lf_tid.shape[0])
     b_cap = lb_v // BLOCK + C + 2
     motion = tp.n_features == 64
-    # the Pallas leaf kernel is built for the 16-feature static layout;
-    # motion packs take the einsum path
-    use_pallas = _use_pallas() and not motion
-    use_prefetch = use_pallas and _use_prefetch()
-    chunk = min(CHUNK * 8 if use_prefetch else CHUNK, b_cap)
+    use_fused = _use_fused(R)
+    chunk = min(CHUNK, b_cap)
     # pack (treelet, ray) into one i32 sort key when the id ranges allow
     # (common case) -> single-array fast sort + ray-sorted runs; else a
     # 2-array (tid, ray) sort
@@ -461,8 +557,9 @@ def _flush(tp: TreeletPack, featT_tab, s: _SState, lb: int,
     def chunk_cond(c):
         return c[0] < n_blocks
 
-    def chunk_body(c):
-        cstart, rayE, rayF, prim, n_tl = c
+    def _block_tables(cstart):
+        """Shared per-chunk block tables, all derived from the sorted
+        buffer with batched row copies (sort-derived, near-bandwidth)."""
         bids = cstart + jnp.arange(chunk, dtype=jnp.int32)  # (CH,)
         # gather (not dynamic_slice): a slice's clamped start would
         # misalign starts against bids on the last chunk when n_blocks
@@ -485,6 +582,66 @@ def _flush(tp: TreeletPack, featT_tab, s: _SState, lb: int,
             bids < n_blocks, tid_s[jnp.minimum(starts, lb_v - 1)], 0
         )
         tids = jnp.clip(tids, 0, C - 1)
+        return bids, rows, tids
+
+    if use_fused:
+        # fused wavefront flush (accel/fusedwave.py): ONE Pallas grid
+        # per chunk covers the phi build (in-kernel gather from the
+        # VMEM-resident ray table), the treelet feature DMA (scalar-
+        # prefetch index_map — the schedule the retired TPU_PBRT_
+        # PREFETCH kernel introduced), the MT matmul + decode, and the
+        # per-ray closest-hit merge against VMEM accumulators. The only
+        # HBM round trip per chunk is the (R,) t/prim winner pair — the
+        # (CH, F, BLOCK) phi tensor, the (CH, F, 4L) gathered features
+        # and the (CH, BLOCK, 4L) matmul product of the jnp path below
+        # never exist.
+        from tpu_pbrt.accel.fusedwave import fused_flush_chunk
+
+        interp = _fused_interpret()
+        center_bits = _bits(tp.center)  # (C, 3) f32 bits ride i32 meta
+
+        def chunk_body_fused(c):
+            cstart, t_row, prim, n_tl = c
+            bids, rows, tids = _block_tables(cstart)
+            meta = jnp.stack(
+                [
+                    tids,
+                    tp.offset[tids],
+                    center_bits[tids, 0],
+                    center_bits[tids, 1],
+                    center_bits[tids, 2],
+                    (bids < n_blocks).astype(jnp.int32),
+                    jnp.zeros_like(tids),
+                    jnp.zeros_like(tids),
+                ],
+                axis=1,
+            )  # (CH, 8) per-block scalars for the kernel
+            t_row2, prim2 = fused_flush_chunk(
+                featT_tab, meta, rows, s.rayF, t_row, prim,
+                interpret=interp,
+            )
+            return (
+                cstart + chunk, t_row2, prim2,
+                n_tl + jnp.sum(rows >= 0, dtype=jnp.int32),
+            )
+
+        init = (jnp.int32(0), s.rayF[6], s.prim, s.n_tl)
+        _, t_row, prim, n_tl = jax.lax.while_loop(
+            chunk_cond, chunk_body_fused, init
+        )
+        # the winner t row goes back into BOTH ray tables once per
+        # flush (the kernel never reads row 6 — the merge's strict <
+        # carries the bound), keeping the tables layout-stable
+        rayE = jax.lax.dynamic_update_slice(s.rayE, t_row[None, :], (6, 0))
+        rayF = jax.lax.dynamic_update_slice(s.rayF, t_row[None, :], (6, 0))
+        return s._replace(
+            rayE=rayE, rayF=rayF, prim=prim,
+            n_lf=jnp.int32(0), n_tl=n_tl, iters=s.iters + 1,
+        )
+
+    def chunk_body(c):
+        cstart, rayE, rayF, prim, n_tl = c
+        bids, rows, tids = _block_tables(cstart)
         has_ray = rows >= 0
         rid = jnp.where(has_ray, rows, 0)
         ctr = tp.center[tids]  # (CH, 3)
@@ -515,25 +672,12 @@ def _flush(tp: TreeletPack, featT_tab, s: _SState, lb: int,
                  phiT * (tm_r * tm_r * tm_r)[:, None, :]],
                 axis=1,
             )  # (CH, 64, BLOCK)
-        if use_prefetch:
-            # full feature table stays in HBM; the kernel's scalar-prefetch
-            # index_map DMAs each block's treelet row directly (no
-            # materialized (CH, 16, 4L) gather)
-            from tpu_pbrt.accel.leafkernel import leaf_blocks_intersect_prefetch
-
-            t_loc, k_loc = leaf_blocks_intersect_prefetch(featT_tab, tids, phiT, t_b)
-        elif use_pallas:
-            from tpu_pbrt.accel.leafkernel import leaf_blocks_intersect
-
-            featT = featT_tab[tids]  # (CH, 16, 4L)
-            t_loc, k_loc = leaf_blocks_intersect(featT, phiT, t_b)
-        else:
-            featT = featT_tab[tids]  # (CH, 16, 4L)
-            out = jnp.einsum(
-                "cfb,cfk->cbk", phiT, featT,
-                precision=jax.lax.Precision.HIGHEST,
-            )
-            t_loc, k_loc, _, _ = decode_outputs(out, L, t_b)
+        featT = featT_tab[tids]  # (CH, F, 4L)
+        out = jnp.einsum(
+            "cfb,cfk->cbk", phiT, featT,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        t_loc, k_loc, _, _ = decode_outputs(out, L, t_b)
         won = has_ray & jnp.isfinite(t_loc)  # t_loc < t[ray] by decode
         rayE2, rayF2, prim2 = _merge_chunk(
             rayE, rayF, prim, rid, t_loc, k_loc, off, won, R
@@ -568,6 +712,11 @@ def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool,
     cidT = tp.top.child_idx.T  # (8, N)
     use_onehot = _use_onehot(int(boxT.shape[2]))
     tab64 = _node_table(boxT, cidT) if use_onehot else None
+    # the fused EXPAND kernel additionally needs the node table VMEM-
+    # resident, so it gates on top-tree size; the fused FLUSH does not
+    use_fused_exp = _use_fused(R) and int(boxT.shape[2]) <= int(
+        cfg.fused_max_nodes
+    )
     featT_tab = tp.featT  # (C, 16, 4L), stored at build
 
     t_max = jnp.asarray(t_max, jnp.float32)
@@ -622,7 +771,7 @@ def _traverse(tp: TreeletPack, o, d, t_max, any_hit: bool,
             do_flush,
             lambda ss: _flush(tp, featT_tab, ss, lb, any_hit),
             lambda ss: _expand(tp, tab64, boxT, cidT, ss, slab, w,
-                               lb, any_hit, use_onehot),
+                               lb, any_hit, use_onehot, use_fused_exp),
             s,
         )
 

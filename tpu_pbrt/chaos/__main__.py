@@ -346,7 +346,53 @@ def scen_mesh_device_loss(tmp):
     )
 
 
+def scen_fused_tracer(tmp):
+    """Fused-wavefront tracer swap (ISSUE 9): the TPU_PBRT_FUSED=1
+    program (Pallas flush/expand kernels, interpret mode on CPU) must
+    render BIT-identical to the jnp path — through a mid-render
+    dispatch failure, so the recovery ladder runs over the fused
+    program too. Uses a killeroo-like scene: the matrix's cornell box
+    compiles to the brute MXU path and would never touch the stream
+    tracer the fused kernels live in."""
+    import numpy as np
+
+    from tpu_pbrt.chaos import CHAOS
+
+    def render(fused, plan=None):
+        with _env(TPU_PBRT_CHUNK=CHUNK, TPU_PBRT_FUSED=fused,
+                  TPU_PBRT_RETRY_BACKOFF="0.01"):
+            if plan:
+                CHAOS.install(plan, seed=0)
+            try:
+                from tpu_pbrt.scenes import compile_api, make_killeroo_like
+
+                api = make_killeroo_like(
+                    res=16, spp=2, integrator="path", maxdepth=3,
+                    n_theta=24, n_phi=48,
+                )
+                scene, integ = compile_api(api)
+                out = integ.render(scene)
+            finally:
+                rep = CHAOS.report()
+                CHAOS.clear()
+        return out, rep
+
+    ref, _ = render("0")
+    r, rep = render("1", plan="dispatch:fail@chunk=1")
+    fired = {e["fault"]: e["fired"] for e in rep}
+    if sum(fired.values()) != 1:
+        return False, f"dispatch fault fired {fired}, wanted 1"
+    if r.stats.get("tracer_mode") != "fused":
+        return False, f"tracer_mode={r.stats.get('tracer_mode')!r}, wanted 'fused'"
+    if not _identical(_film(r), _film(ref)):
+        return False, "fused film NOT bit-identical to jnp render"
+    if r.rays_traced != ref.rays_traced:
+        return False, f"rays {r.rays_traced} != {ref.rays_traced}"
+    return True, f"fused == jnp bit-identical; fired={fired}"
+
+
 SCENARIOS = {
+    "fused-tracer": scen_fused_tracer,
     "clean-redispatch": scen_clean_redispatch,
     "poison-rollback": scen_poison_rollback,
     "poison-restart": scen_poison_restart,

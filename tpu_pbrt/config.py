@@ -16,6 +16,7 @@ contract.
 from __future__ import annotations
 
 import os
+import warnings
 from typing import Optional
 
 
@@ -72,7 +73,9 @@ class Config:
         "bvh",
         "leaf_tris",
         "pallas",
-        "prefetch",
+        "fused",
+        "fused_max_rays",
+        "fused_max_nodes",
         "onehot",
         "slab",
         "headroom",
@@ -105,10 +108,40 @@ class Config:
         self.bvh: str = os.environ.get("TPU_PBRT_BVH", "stream")
         #: triangles per stream-path treelet leaf (None -> STREAM_LEAF_TRIS)
         self.leaf_tris: Optional[int] = _int("TPU_PBRT_LEAF_TRIS", None)
-        #: fused Pallas leaf kernel on real TPUs (0 forces the XLA einsum)
+        #: Pallas kernels allowed at all (0 = the jnp/XLA escape hatch,
+        #: overriding TPU_PBRT_FUSED)
         self.pallas: bool = _flag("TPU_PBRT_PALLAS", True)
-        #: opt-in scalar-prefetch leaf kernel variant
-        self.prefetch: bool = _flag("TPU_PBRT_PREFETCH", False)
+        #: fused Pallas wavefront kernel (accel/fusedwave.py): flush
+        #: phase (phi build + treelet DMA + MT matmul + closest-hit
+        #: merge) and node expansion in single Pallas grids. Tri-state:
+        #: 1 forces it on (interpret mode on CPU — the testing story),
+        #: 0 forces the jnp path, unset = auto (on for TPU backends,
+        #: off on CPU)
+        self.fused: Optional[bool] = _triflag("TPU_PBRT_FUSED")
+        #: wave-size ceiling for the fused kernels: the per-ray tables
+        #: ((8, R) rayF + the (R,) winner accumulators) must be
+        #: VMEM-resident, so waves past this fall back to the jnp path
+        #: (see README "Accel kernels" for the budget math)
+        self.fused_max_rays: int = _int("TPU_PBRT_FUSED_MAX_RAYS", 1 << 18)
+        #: top-tree node ceiling for the fused EXPAND kernel (the
+        #: (48, N) box table must be VMEM-resident); flush fusion is
+        #: independent of this
+        self.fused_max_nodes: int = _int("TPU_PBRT_FUSED_MAX_NODES", 1 << 14)
+        # TPU_PBRT_PREFETCH (the standalone scalar-prefetch leaf kernel
+        # of PRs <= 8) is retired: the fused wavefront kernel owns the
+        # same DMA schedule plus everything around it. The knob aliases
+        # to TPU_PBRT_FUSED=1 so old launch scripts keep working.
+        if _flag("TPU_PBRT_PREFETCH", False):
+            warnings.warn(
+                "TPU_PBRT_PREFETCH is deprecated: the scalar-prefetch "
+                "leaf kernel was subsumed by the fused wavefront kernel "
+                "(accel/fusedwave.py). Treating it as TPU_PBRT_FUSED=1; "
+                "set TPU_PBRT_FUSED explicitly.",
+                DeprecationWarning,
+                stacklevel=2,
+            )
+            if self.fused is None:
+                self.fused = True
         #: one-hot MXU matmul for small-table gathers in EXPAND
         self.onehot: bool = _flag("TPU_PBRT_ONEHOT", True)
         #: stream worklist slab cap (pairs per EXPAND step)

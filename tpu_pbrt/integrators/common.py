@@ -286,6 +286,13 @@ def _fixed_batch_nonfinite(p_film, L):
     return jnp.sum(nonfinite_mask(L) & valid, dtype=jnp.int32)
 
 
+#: the stream tracer mode ("jnp" | "fused") the most recent chunk plan
+#: compiled against — process-wide, because the stream module's jitted
+#: entry points are process-wide (see the cache-drop note in
+#: prepare_chunks)
+_LAST_TRACER: list = []
+
+
 @dataclass
 class ChunkPlan:
     """The chunked decomposition of one render's work domain plus the
@@ -321,6 +328,11 @@ class ChunkPlan:
     starts: list
     jfn: Any
     fingerprint: str
+    #: which stream flush/expand program the plan's closure compiled to
+    #: ("fused" = the Pallas wavefront kernels, "jnp" = the XLA path) —
+    #: surfaced in RenderResult.stats / bench telemetry for roofline
+    #: attribution, and part of the jit-closure cache identity
+    tracer: str = "jnp"
 
     def dispatch(self, state, c: int):
         """Dispatch chunk ``c`` against ``state`` (the film accumulator
@@ -980,11 +992,34 @@ class WavefrontIntegrator:
         # single-device pool drain (-1 = clean); its PRESENCE is static
         # program shape, so it is part of the closure identity
         chaos_nan = CHAOS.has_nan() and use_regen and mesh is None
+        # the fused-wavefront switch (TPU_PBRT_FUSED / _PALLAS) selects
+        # which flush/expand program _bounce_wave's tracer compiles to —
+        # a config reload() flipping it between renders must retrace,
+        # not reuse the stale closure (same contract as the telemetry
+        # kill switch). The wave the tracer sees is the fused 2R
+        # camera+shadow batch PER DEVICE: pool slots under regen, else
+        # the per-device chunk slice (2*chunk would misattribute mesh
+        # renders near the FUSED_MAX_RAYS boundary — and a mislabeled
+        # key is a stale-closure hole, not just a wrong stat).
+        from tpu_pbrt.accel.stream import tracer_mode as _tracer_mode
+
+        tracer = _tracer_mode(2 * (pool if use_regen else per_dev))
         jit_key = (
             scene, mesh, chunk, spp, total, n_dev, pool, use_regen,
-            _obs_counters.enabled(), CHAOS.trace_key(),
+            _obs_counters.enabled(), CHAOS.trace_key(), tracer,
         )
         cached = getattr(self, "_jit_cache", None)
+        if _LAST_TRACER and _LAST_TRACER[-1] != tracer:
+            # the stream tracer's module-level jits cache by aval shape
+            # alone AND are shared across integrator instances; a
+            # tracer-mode flip (TPU_PBRT_FUSED reload) with unchanged
+            # shapes would let any later trace — even a brand-new
+            # integrator's — inline a STALE inner jaxpr labeled with
+            # the new mode. Drop the inner caches at every flip.
+            from tpu_pbrt.accel.stream import clear_traverse_caches
+
+            clear_traverse_caches()
+        _LAST_TRACER[:] = [tracer]
         if cached is not None and all(
             a is b if i < 2 else a == b for i, (a, b) in enumerate(zip(cached[0], jit_key))
         ):
@@ -1115,7 +1150,7 @@ class WavefrontIntegrator:
             chunk=chunk, per_dev=per_dev, n_dev=n_dev, n_chunks=n_chunks,
             spp=spp, total=total, npix=npix, bounds=(x0, x1, y0, y1),
             pool=pool, use_regen=use_regen, chaos_nan=chaos_nan,
-            starts=starts, jfn=jfn, fingerprint=fp,
+            starts=starts, jfn=jfn, fingerprint=fp, tracer=tracer,
         )
 
     # -- the loop ---------------------------------------------------------
@@ -1290,7 +1325,7 @@ class WavefrontIntegrator:
                         with TRACE.span(
                             "render/chunk_dispatch+compile"
                             if c == first_chunk else "render/chunk_dispatch",
-                            chunk=c,
+                            chunk=c, tracer=plan.tracer,
                         ):
                             state, aux = plan.dispatch(state, c)
                     except jax.errors.JaxRuntimeError as e:
@@ -1493,6 +1528,12 @@ class WavefrontIntegrator:
 
                     _W(f"could not write image {film.filename}: {e}")
         stats: Dict[str, Any] = {}
+        if "tstream" in scene.dev:
+            # which flush/expand program the stream tracer compiled to
+            # (jnp | fused) — bench.py copies this into its telemetry
+            # block so live captures attribute the roofline ratio to
+            # the right kernel
+            stats["tracer_mode"] = plan.tracer
         if any(recovery.values()):
             # the render survived at least one failure — surface the
             # full retry/rollback/backoff accounting next to the image
