@@ -345,6 +345,7 @@ def main():
             line["telemetry"] = {
                 "counters": None, "wave_spread": None,
                 "tracer_mode": None, "fused_blocks_per_flush": None,
+                "phase_seconds": None,
                 "live_bytes_per_sec": None, "live_flops_per_sec": None,
                 "hbm_peak_bytes_per_sec": None,
                 "live_vs_static_ratio": None,
@@ -530,6 +531,7 @@ def main():
     # BENCH rows stay schema-comparable)
     import jax as _jax
 
+    from tpu_pbrt.obs.metrics import phase_summary
     from tpu_pbrt.obs.rooflive import live_vs_static
 
     tstats = result.stats.get("telemetry") or {}
@@ -551,6 +553,12 @@ def main():
         "wave_spread": tstats.get("wave_spread"),
         "tracer_mode": result.stats.get("tracer_mode"),
         "fused_blocks_per_flush": fused_blocks,
+        # per-phase wall-time histogram summary (ISSUE 10): dispatch vs
+        # device-wait vs deposit-develop vs checkpoint across every leg
+        # this process ran, labeled by tracer in the registry — the
+        # fused-vs-jnp phase evidence ROADMAP #1 stage two waits on
+        # (null under TPU_PBRT_METRICS=0; rows stay schema-comparable)
+        "phase_seconds": phase_summary(),
         **live_vs_static(
             waves=result.stats.get("n_waves"),
             seconds=result.seconds,
@@ -571,6 +579,9 @@ def main():
         line.update(crown)
     FLIGHT.heartbeat("report", mray_per_sec=line.get("value"))
     TRACE.maybe_export()
+    from tpu_pbrt.obs.metrics import METRICS
+
+    METRICS.maybe_export()  # TPU_PBRT_METRICS_PATH snapshot, if armed
     print(json.dumps(line))
 
 

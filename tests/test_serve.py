@@ -27,6 +27,9 @@ from tpu_pbrt.serve import (
     FairScheduler,
     RenderService,
     ResidencyCache,
+    ShedError,
+    SloPolicy,
+    parse_slo_spec,
     preemption_victim,
     scene_hbm_bytes,
 )
@@ -312,6 +315,146 @@ def test_step_failure_quarantines_job_not_service(solo_ref):
     )
     # the failed job no longer pins its scene
     assert svc.residency.get(svc.jobs[bad].resident_key).pins == 0
+
+
+# --------------------------------------------------------------------------
+# SLO load shedding + the metrics surface (ISSUE 10)
+# --------------------------------------------------------------------------
+
+
+def test_slo_depth_shed_is_deterministic_and_precompile(solo_ref):
+    """An over-SLO burst sheds deterministically BEFORE compiling or
+    queuing anything; once the class drains, admission opens again and
+    the admitted work renders bit-identical to solo."""
+    svc = _service(slo=SloPolicy(depth=parse_slo_spec("1", int)))
+    j1 = svc.submit(text=TEXT, tenant="alice")
+    compiles = svc.residency.stats()["scene_compiles"]
+    reasons = []
+    for _ in range(3):
+        with pytest.raises(ShedError) as ei:
+            svc.submit(text=TEXT, tenant="bob")
+        reasons.append(ei.value.reason)
+    assert svc.sheds == 3
+    assert len(set(reasons)) == 1 and "depth" in reasons[0]
+    # shedding never touched the compiler or the job table
+    assert svc.residency.stats()["scene_compiles"] == compiles
+    assert list(svc.jobs) == [j1]
+    svc.drain()
+    j2 = svc.submit(text=TEXT, tenant="bob")  # class drained: admitted
+    svc.drain()
+    assert np.array_equal(
+        np.asarray(svc.result(j2).image, np.float32), solo_ref
+    )
+
+
+def test_slo_wait_shed_recovers_no_lockout():
+    """Wait-SLO sheds while the class is congested, but the signal is a
+    bounded window consulted only with queued work — once the queue
+    drains, an idle class admits again (no permanent lockout from a
+    past congestion spike)."""
+    from collections import deque
+
+    svc = _service(slo=SloPolicy(wait_s=parse_slo_spec("0.5", float)))
+    j1 = svc.submit(text=TEXT, tenant="alice")  # depth 0: wait not consulted
+    # simulate a congestion history: recent class-0 waits p90 over target
+    svc._recent_waits[0] = deque([1.0] * 8, maxlen=32)
+    with pytest.raises(ShedError, match="queue-wait p90"):
+        svc.submit(text=TEXT, tenant="bob")
+    assert svc.sheds == 1
+    svc.drain()  # queue empties; the stale window must not lock the class
+    j2 = svc.submit(text=TEXT, tenant="bob")
+    svc.drain()
+    assert svc.jobs[j1].status == "done" and svc.jobs[j2].status == "done"
+
+
+def test_service_metrics_exposition_per_tenant(solo_ref):
+    """The registry page lints clean and carries the per-tenant
+    queue-wait/service-time histograms the acceptance names."""
+    from tpu_pbrt.obs.metrics import METRICS, validate_exposition
+
+    METRICS.reset()
+    svc = _service()
+    j1 = svc.submit(text=TEXT, tenant="alice")
+    svc.submit(text=TEXT, tenant="bob")
+    svc.drain()
+    exp = svc.metrics_exposition()
+    assert validate_exposition(exp) == []
+    for needle in (
+        "tpu_pbrt_serve_queue_wait_seconds_bucket",
+        "tpu_pbrt_serve_slice_seconds_count",
+        'tenant="alice"',
+        'tenant="bob"',
+        "tpu_pbrt_residency_hits_total",
+        "tpu_pbrt_serve_queue_depth",
+    ):
+        assert needle in exp, f"exposition missing {needle}"
+    # films unaffected by the instrumentation
+    assert np.array_equal(
+        np.asarray(svc.result(j1).image, np.float32), solo_ref
+    )
+
+
+def test_metrics_kill_switch_service_byte_identical(
+    solo_ref, monkeypatch
+):
+    """TPU_PBRT_METRICS=0: the service renders the same bits, responds
+    the same, and the exposition is empty (acceptance kill-switch
+    criterion applied to serving)."""
+    from tpu_pbrt import config
+    from tpu_pbrt.obs.metrics import METRICS
+
+    monkeypatch.setenv("TPU_PBRT_METRICS", "0")
+    config.reload()
+    METRICS.reset()
+    svc = _service(slo=SloPolicy(depth=parse_slo_spec("1", int)))
+    j = svc.submit(text=TEXT, tenant="alice")
+    with pytest.raises(ShedError):
+        svc.submit(text=TEXT, tenant="alice")  # depth shed still works
+    svc.drain()
+    assert svc.metrics_exposition() == ""
+    assert METRICS.exposition() == ""
+    assert np.array_equal(
+        np.asarray(svc.result(j).image, np.float32), solo_ref
+    )
+
+
+def test_daemon_metrics_verb_and_shed_roundtrip():
+    """JSONL round trip: an over-SLO submit answers {"shed": true}; the
+    `metrics` verb returns a lint-clean Prometheus exposition carrying
+    the shed counter and per-tenant histograms."""
+    import io
+    import json
+
+    from tpu_pbrt.obs.metrics import METRICS, validate_exposition
+    from tpu_pbrt.serve.__main__ import run_daemon
+
+    METRICS.reset()
+    svc = _service(slo=SloPolicy(depth=parse_slo_spec("1", int)))
+    cmds = "\n".join(json.dumps(c) for c in [
+        {"op": "submit", "text": TEXT, "tenant": "alice"},
+        {"op": "submit", "text": TEXT, "tenant": "bob"},
+        {"op": "metrics"},
+        {"op": "shutdown", "drain": True},
+    ]) + "\n"
+    out = io.StringIO()
+    assert run_daemon(svc, in_stream=io.StringIO(cmds), out=out) == 0
+    lines = [json.loads(x) for x in out.getvalue().splitlines()]
+    submits = [d for d in lines if d.get("op") == "submit"]
+    assert submits[0]["ok"] is True
+    assert submits[1] == {
+        "ok": False, "op": "submit", "shed": True, "tenant": "bob",
+        "priority": 0, "reason": submits[1]["reason"],
+    }
+    assert "depth" in submits[1]["reason"]
+    met = [d for d in lines if d.get("op") == "metrics"]
+    assert len(met) == 1 and met[0]["ok"]
+    exp = met[0]["exposition"]
+    assert validate_exposition(exp) == []
+    assert "tpu_pbrt_serve_shed_total" in exp
+    assert 'tenant="bob"' in exp
+    # the admitted job still completed through the daemon loop
+    done = [d for d in lines if d.get("event") == "done"]
+    assert len(done) == 1
 
 
 # --------------------------------------------------------------------------

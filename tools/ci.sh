@@ -48,10 +48,12 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_backend_optimization_level=0" \
 TPU_PBRT_FLIGHT_PATH="$SMOKE_DIR/flight.jsonl" \
 python -m tpu_pbrt.main scenes/cornell-path.pbrt --quick --quiet \
     --cropwindow 0 0.25 0 0.25 \
-    -o "$SMOKE_DIR/smoke.pfm" --trace "$SMOKE_DIR/trace.json"
+    -o "$SMOKE_DIR/smoke.pfm" --trace "$SMOKE_DIR/trace.json" \
+    --metrics-path "$SMOKE_DIR/metrics.prom"
 python -m tpu_pbrt.obs "$SMOKE_DIR/trace.json" \
     --flight "$SMOKE_DIR/flight.jsonl" \
-    --require-phases render,render_done,develop --min-spans 3
+    --require-phases render,render_done,develop --min-spans 3 \
+    --metrics "$SMOKE_DIR/metrics.prom"
 
 # fused-kernel interpret-mode smoke (ISSUE 9): render a small scene
 # with TPU_PBRT_FUSED=1 (Pallas wavefront kernels, interpret mode on
@@ -73,13 +75,30 @@ python -m tpu_pbrt.chaos --only fused-tracer
 echo "== chaos recovery matrix (python -m tpu_pbrt.chaos)"
 python -m tpu_pbrt.chaos
 
-# render-service smoke (ISSUE 6): submit two cropped cornell jobs to one
-# service, preempt/resume one mid-render, and require both films finite
-# AND bit-identical to a solo run-to-completion render, a warm resubmit
-# with 0 scene compiles + 0 jit retraces, and >= 1 streamed preview.
+# render-service smoke (ISSUE 6 + ISSUE 10): submit two cropped cornell
+# jobs to one service, preempt/resume one mid-render, and require both
+# films finite AND bit-identical to a solo run-to-completion render, a
+# warm resubmit with 0 scene compiles + 0 jit retraces, >= 1 streamed
+# preview, a DETERMINISTIC shed count from an over-SLO submit burst, and
+# a lint-clean Prometheus metrics exposition with per-tenant histograms.
 echo "== render service smoke (python -m tpu_pbrt.serve --selftest)"
 XLA_FLAGS="${XLA_FLAGS:-} --xla_backend_optimization_level=0" \
 python -m tpu_pbrt.serve --selftest
+
+# metrics registry selftest + bench trajectory report (ISSUE 10
+# satellites): the registry's record -> exposition -> lint -> percentile
+# loop must close with zero renders, and the committed BENCH_r*.json
+# captures must still parse into the one-table perf trajectory —
+# non-zero here means the bench JSON schema drifted. The regenerated
+# table is committed as BENCH_REPORT.md; refresh it after a capture.
+echo "== metrics selftest + bench trajectory report"
+python -m tpu_pbrt.obs --metrics-selftest
+python tools/bench_report.py > "$SMOKE_DIR/bench_report.md"
+if ! diff -q "$SMOKE_DIR/bench_report.md" BENCH_REPORT.md >/dev/null 2>&1; then
+    echo "   BENCH_REPORT.md is stale — regenerate with:"
+    echo "   python tools/bench_report.py > BENCH_REPORT.md"
+    exit 1
+fi
 
 if [[ "${1:-}" == "--fast" ]]; then
     echo "== pytest skipped (--fast)"

@@ -93,8 +93,13 @@ class Config:
         "allow_drops",
         "shard_native_check",
         "telemetry",
+        "metrics",
+        "metrics_path",
         "trace_path",
         "flight_path",
+        "flight_max_mb",
+        "serve_slo_depth",
+        "serve_slo_wait_s",
         "faults",
         "nonfinite",
         "retry_max",
@@ -199,6 +204,19 @@ class Config:
         #: 0 is the kill switch — the drain compiles to the exact
         #: pre-telemetry program (the counter carry is a None pytree leaf)
         self.telemetry: bool = _flag("TPU_PBRT_TELEMETRY", True)
+        #: host-side metrics registry (tpu_pbrt/obs/metrics.py):
+        #: counters/gauges/histograms over the serve path and the render
+        #: drain loop, Prometheus exposition, SLO load-shedding inputs.
+        #: 0 is the kill switch — every record call is a no-op and render
+        #: stats / serve responses are byte-identical to a build without
+        #: the registry (host-side only; the compiled programs never see
+        #: it either way)
+        self.metrics: bool = _flag("TPU_PBRT_METRICS", True)
+        #: Prometheus text snapshot file the registry exports to (also
+        #: settable per-run via --metrics-path on main.py / serve)
+        self.metrics_path: Optional[str] = os.environ.get(
+            "TPU_PBRT_METRICS_PATH"
+        ) or None
         #: Chrome-trace/Perfetto JSON output path for the span recorder
         #: (also settable per-run via --trace on main.py / bench.py)
         self.trace_path: Optional[str] = os.environ.get(
@@ -209,6 +227,30 @@ class Config:
         self.flight_path: Optional[str] = os.environ.get(
             "TPU_PBRT_FLIGHT_PATH"
         ) or None
+        #: flight-recorder growth cap in MB: at a flush boundary past the
+        #: cap the file rotates ONCE to `<path>.1` (previous rotation
+        #: overwritten) — a long-lived serve daemon must not grow its
+        #: append-only JSONL without bound. None/0 = unbounded
+        self.flight_max_mb: Optional[float] = _float(
+            "TPU_PBRT_FLIGHT_MAX_MB", None
+        )
+        #: serve SLO admission control (ISSUE 10 / ROADMAP #2 load
+        #: shedding): per-priority-class queue-DEPTH targets — a submit
+        #: that would push the class's runnable-job count past its target
+        #: is answered with a deterministic `shed` instead of queued.
+        #: Spec grammar: "8" (every class) or "0=4,5=32" (per class int,
+        #: `default=` for the rest); empty = no depth shedding
+        self.serve_slo_depth: str = os.environ.get(
+            "TPU_PBRT_SERVE_SLO_DEPTH", ""
+        ).strip()
+        #: ... and per-class queue-WAIT targets in seconds: shed while
+        #: the class has queued work AND its recent p90 queue wait (a
+        #: bounded in-service window — deliberately NOT the registry's
+        #: lifetime histogram, whose p90 could never recover once
+        #: elevated) exceeds the target. Same spec grammar
+        self.serve_slo_wait_s: str = os.environ.get(
+            "TPU_PBRT_SERVE_SLO_WAIT_S", ""
+        ).strip()
         #: declarative fault-injection plan (tpu_pbrt/chaos grammar, e.g.
         #: "dispatch:poison@chunk=3,ckpt:torn@write=2"); empty = no chaos.
         #: Installed into the CHAOS registry once at chaos-package import

@@ -1199,7 +1199,24 @@ class WavefrontIntegrator:
         from tpu_pbrt.chaos import CHAOS
         from tpu_pbrt.obs import counters as obs_counters
         from tpu_pbrt.obs.flight import FLIGHT
+        from tpu_pbrt.obs.metrics import METRICS, phase_histogram
         from tpu_pbrt.obs.trace import TRACE
+
+        # per-phase wall-time attribution (ISSUE 10 / ROADMAP #1 stage
+        # two): dispatch vs device-wait vs deposit-develop vs checkpoint,
+        # observed into the process-wide phase histogram with the plan's
+        # tracer label — one live capture yields the fused-vs-jnp phase
+        # breakdown. Host-side only: the timed regions already exist,
+        # the clock reads cost nothing the TRACE spans don't, and with
+        # TPU_PBRT_METRICS=0 nothing is recorded or reported at all.
+        metrics_on = METRICS.enabled
+        phase_s: Dict[str, float] = {}
+
+        def _phase(name: str, dt: float) -> None:
+            if not metrics_on:
+                return
+            phase_s[name] = phase_s.get(name, 0.0) + dt
+            phase_histogram().observe(dt, phase=name, tracer=plan.tracer)
 
         # pre-render stream-capacity audit (fails loudly on a worklist
         # overflow — see ChunkPlan.capacity_audit)
@@ -1322,12 +1339,18 @@ class WavefrontIntegrator:
                         # trace+compile; later ones are async enqueues —
                         # the span names keep the two distinguishable in
                         # the exported trace
+                        t_ph = time.perf_counter()
                         with TRACE.span(
                             "render/chunk_dispatch+compile"
                             if c == first_chunk else "render/chunk_dispatch",
                             chunk=c, tracer=plan.tracer,
                         ):
                             state, aux = plan.dispatch(state, c)
+                        _phase(
+                            "dispatch_compile" if c == first_chunk
+                            else "dispatch",
+                            time.perf_counter() - t_ph,
+                        )
                     except jax.errors.JaxRuntimeError as e:
                         # real device/runtime loss mid-dispatch: the donated
                         # film accumulator can no longer be trusted — route
@@ -1456,6 +1479,7 @@ class WavefrontIntegrator:
                         render_s=round(time.time() - t0, 3),
                     )
                 if ckpt_path and checkpoint_every and c % checkpoint_every == 0:
+                    t_ph = time.perf_counter()
                     with TRACE.span("render/checkpoint", chunk=c):
                         save_checkpoint(
                             ckpt_path,
@@ -1466,6 +1490,7 @@ class WavefrontIntegrator:
                             fingerprint=fp,
                             counters=ctr_snapshot(),
                         )
+                    _phase("checkpoint", time.perf_counter() - t_ph)
                 if max_seconds > 0:
                     # time-boxed mode: block on a chunk a few dispatches
                     # BACK, so the wall clock tracks completed work while
@@ -1482,15 +1507,19 @@ class WavefrontIntegrator:
                     eager = done_n <= lag or (
                         max_seconds - (time.time() - t0) < (lag + 2) * rate
                     )
+                    t_ph = time.perf_counter()
                     jax.block_until_ready(
                         ray_counts[-1] if eager else ray_counts[-1 - lag]
                     )
+                    _phase("device_wait", time.perf_counter() - t_ph)
                     if time.time() - t0 > max_seconds:
                         break
             # device execution of the queued wave batches (and, on a
             # mesh, the ICI film psum/merge) completes inside this sync
+            t_ph = time.perf_counter()
             with TRACE.span("render/wave_drain+film_merge"):
                 jax.block_until_ready(state)
+            _phase("device_wait", time.perf_counter() - t_ph)
         secs = time.time() - t0
         progress.done()
         completed_fraction = chunks_done / max(n_chunks, 1)
@@ -1506,16 +1535,19 @@ class WavefrontIntegrator:
         else:
             FLIGHT.heartbeat("render_done", rays=rays, seconds=round(secs, 3))
         if ckpt_path:
+            t_ph = time.perf_counter()
             save_checkpoint(
                 ckpt_path, state, chunks_done, rays, fingerprint=fp,
                 counters=ctr_total,
             )
+            _phase("checkpoint", time.perf_counter() - t_ph)
         # pbrt film.cpp WriteImage splatScale: splats (BDPT t=1, MLT, SPPM)
         # are deposited once per SAMPLE, so the developed image divides by
         # the number of samples actually taken — a time-boxed partial
         # render deposited only completed_fraction of them (the rgb plane
         # self-normalizes via its weight sum; the splat plane cannot)
         n_splat_samples = max(spp * completed_fraction, 1e-9)
+        t_ph = time.perf_counter()
         with TRACE.span("render/develop"):
             img = film.develop(state, splat_scale=1.0 / n_splat_samples)
         FLIGHT.heartbeat("develop")
@@ -1527,6 +1559,7 @@ class WavefrontIntegrator:
                     from tpu_pbrt.utils.error import Warning as _W
 
                     _W(f"could not write image {film.filename}: {e}")
+        _phase("deposit_develop", time.perf_counter() - t_ph)
         stats: Dict[str, Any] = {}
         if "tstream" in scene.dev:
             # which flush/expand program the stream tracer compiled to
@@ -1592,6 +1625,15 @@ class WavefrontIntegrator:
             stats["telemetry"] = {
                 "counters": ctr_total,
                 "wave_spread": obs_counters.spread_stats(per_dev),
+            }
+        if metrics_on and phase_s:
+            # per-phase wall totals for THIS render (the cross-render
+            # histogram with percentiles lives in the METRICS registry;
+            # bench.py summarizes it via obs.metrics.phase_summary).
+            # Present only with the registry on, so TPU_PBRT_METRICS=0
+            # pins the exact pre-registry stats dict.
+            stats["phase_seconds"] = {
+                k: round(v, 6) for k, v in sorted(phase_s.items())
             }
         TRACE.maybe_export()
         return RenderResult(

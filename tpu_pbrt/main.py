@@ -60,6 +60,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "ui.perfetto.dev",
     )
     p.add_argument(
+        "--metrics-path",
+        default="",
+        metavar="OUT.prom",
+        help="write a Prometheus text snapshot of the host metrics "
+        "registry (phase-time histograms etc.) on exit; also settable "
+        "via TPU_PBRT_METRICS_PATH (TPU_PBRT_METRICS=0 disables)",
+    )
+    p.add_argument(
         "--faults",
         default="",
         metavar="PLAN",
@@ -88,6 +96,7 @@ def main(argv=None) -> int:
         checkpoint_every=args.checkpoint_every,
         multihost=args.multihost,
     )
+    from tpu_pbrt.obs.metrics import METRICS
     from tpu_pbrt.obs.trace import TRACE
     from tpu_pbrt.parallel.mesh import maybe_init_distributed
 
@@ -101,6 +110,8 @@ def main(argv=None) -> int:
         CHAOS.install(args.faults)
     if args.trace:
         TRACE.configure(args.trace)
+    if args.metrics_path:
+        METRICS.configure(args.metrics_path)
     maybe_init_distributed(opts)
     if args.serve:
         from tpu_pbrt.parallel.mesh import resolve_mesh
@@ -130,6 +141,7 @@ def main(argv=None) -> int:
             return run_daemon(service)
         finally:
             TRACE.maybe_export()
+            METRICS.maybe_export()
     try:
         for scene in args.scenes:
             try:
@@ -144,6 +156,7 @@ def main(argv=None) -> int:
         # main/render_file spans — and runs on the FAILURE path too,
         # where the trace matters most
         TRACE.maybe_export()
+        METRICS.maybe_export()
 
 
 if __name__ == "__main__":

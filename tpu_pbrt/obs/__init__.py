@@ -12,7 +12,11 @@ Four pieces, one per module:
   counter snapshots + backend probe state) so an infra-outage capture
   carries a diagnosis instead of a bare error string;
 - `rooflive`  — live-vs-static roofline cross-check of measured wave
-  rates against the committed static budgets (analysis/budgets.json).
+  rates against the committed static budgets (analysis/budgets.json);
+- `metrics`   — process-wide host-side metrics registry (ISSUE 10):
+  counters/gauges/fixed-bucket histograms with bucket-derived
+  percentiles, Prometheus text exposition, render-phase attribution
+  and the serve SLO load-shedding inputs (`TPU_PBRT_METRICS=0` kills).
 
 All of it is default-on behind `TPU_PBRT_TELEMETRY` (=0 kills it and
 compiles the exact pre-telemetry device program); `python -m
@@ -27,7 +31,7 @@ when the accelerator runtime itself is what's hanging.
 
 import importlib
 
-_SUBMODULES = ("counters", "flight", "rooflive", "trace")
+_SUBMODULES = ("counters", "flight", "metrics", "rooflive", "trace")
 
 
 def __getattr__(name):

@@ -18,6 +18,7 @@ reports it either way) but write nothing.
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -50,6 +51,27 @@ class FlightRecorder:
 
         return bool(cfg.telemetry and self.path)
 
+    def _maybe_rotate(self, path: str):
+        """Growth cap (`TPU_PBRT_FLIGHT_MAX_MB`): single-file rotation at
+        the flush boundary — when the file has grown past the cap it is
+        renamed to `<path>.1` (the previous rotation, if any, is
+        replaced) and appending restarts on a fresh file. A long-lived
+        serve daemon keeps at most 2x the cap on disk instead of an
+        unbounded JSONL; the tail of the timeline is always the readable
+        pair (`<path>.1` then `<path>`)."""
+        from tpu_pbrt.config import cfg
+
+        cap_mb = cfg.flight_max_mb
+        if not cap_mb or cap_mb <= 0:
+            return
+        try:
+            if os.path.getsize(path) >= cap_mb * 1e6:
+                os.replace(path, path + ".1")
+        except OSError:
+            # missing file (nothing to rotate) or an unwritable dir —
+            # the heartbeat's own open() will surface/swallow that
+            pass
+
     def heartbeat(self, phase: str, **fields):
         """One JSONL line: wall clock, elapsed seconds, phase, fields.
         Opened/flushed/closed per line — crash-safe by construction."""
@@ -67,7 +89,9 @@ class FlightRecorder:
             if k not in line:
                 line[k] = v
         try:
-            with open(self.path, "a") as f:
+            path = self.path
+            self._maybe_rotate(path)
+            with open(path, "a") as f:
                 f.write(json.dumps(line) + "\n")
         except OSError:
             # a full/readonly disk must never kill the render it's
@@ -89,8 +113,6 @@ def job_flight_path(base: Optional[str], job_id: str) -> Optional[str]:
     concurrent job into one undiagnosable stream."""
     if not base:
         return None
-    import os
-
     # splitext (not a raw '.' split): it only splits the BASENAME, so a
     # dotted directory (/tmp/run.1/flight) can't be mangled into a
     # nonexistent path whose writes the recorder would silently drop

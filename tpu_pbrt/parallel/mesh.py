@@ -106,7 +106,19 @@ def maybe_init_distributed(options=None) -> bool:
     if not want:
         return False
     try:
+        import time as _time
+
+        from tpu_pbrt.obs.metrics import METRICS
+
+        t0 = _time.perf_counter()
         jax.distributed.initialize()
+        # DCN coordination cost is a render-startup phase a fleet
+        # monitor wants attributed like any other (host-side registry;
+        # no-op under TPU_PBRT_METRICS=0)
+        METRICS.gauge(
+            "distributed_init_seconds",
+            "wall seconds jax.distributed.initialize took",
+        ).set(_time.perf_counter() - t0)
         return True
     except (RuntimeError, ValueError) as e:
         # already initialized counts as success
@@ -134,12 +146,20 @@ def resolve_mesh(mesh_shape) -> Optional[Mesh]:
     devices than exist degrades to single-device (matching the render
     loop's historical behavior) rather than erroring — the scene still
     renders, just not sharded."""
-    if not mesh_shape:
-        return None
-    n_req = int(np.prod(tuple(mesh_shape)))
-    if n_req > 1 and len(jax.devices()) >= n_req:
-        return make_mesh(n_req)
-    return None
+    from tpu_pbrt.obs.metrics import METRICS
+
+    mesh = None
+    if mesh_shape:
+        n_req = int(np.prod(tuple(mesh_shape)))
+        if n_req > 1 and len(jax.devices()) >= n_req:
+            mesh = make_mesh(n_req)
+    # the mesh width every drain in this process fans over — the
+    # denominator a monitor needs next to the per-device wave-spread
+    # telemetry (1 = single-device, incl. a degraded fallback)
+    METRICS.gauge(
+        "mesh_devices", "devices in the resolved render mesh"
+    ).set(1 if mesh is None else mesh.devices.size)
+    return mesh
 
 
 def device_spread(value, n_dev: int, axis: str = TILE_AXIS):

@@ -10,7 +10,12 @@ daemon (`python -m tpu_pbrt.serve`, `--selftest` for the CI smoke), and
 `tpu-pbrt --serve` in main.py.
 """
 
-from tpu_pbrt.serve.queue import FairScheduler, preemption_victim
+from tpu_pbrt.serve.queue import (
+    FairScheduler,
+    SloPolicy,
+    parse_slo_spec,
+    preemption_victim,
+)
 from tpu_pbrt.serve.residency import (
     ResidencyCache,
     ResidentScene,
@@ -27,12 +32,13 @@ from tpu_pbrt.serve.service import (
     QUEUED,
     RenderJob,
     RenderService,
+    ShedError,
 )
 
 __all__ = [
     "ACTIVE", "CANCELLED", "DONE", "FAILED", "PARKED", "PAUSED", "QUEUED",
-    "FairScheduler", "preemption_victim",
+    "FairScheduler", "SloPolicy", "parse_slo_spec", "preemption_victim",
     "ResidencyCache", "ResidentScene", "scene_hbm_bytes",
     "scene_source_key",
-    "RenderJob", "RenderService",
+    "RenderJob", "RenderService", "ShedError",
 ]

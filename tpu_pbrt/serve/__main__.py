@@ -17,7 +17,13 @@ Ops:
   {"op": "preview", "job": "j1", "out": "live.png"}
   {"op": "result",  "job": "j1", "out": "final.exr?"}
   {"op": "stats"}
+  {"op": "metrics", "out": "metrics.prom?"}   # Prometheus text exposition
   {"op": "shutdown", "drain": true}
+
+A submit rejected by SLO admission control (TPU_PBRT_SERVE_SLO_DEPTH /
+_WAIT_S, or --slo-depth/--slo-wait-s) answers {"ok": false, "shed":
+true, "reason": ...} — deterministic, counted in the shed metrics and
+the flight log; nothing was compiled or queued.
 
 Between commands the daemon steps the service (one chunk-slice per
 step, policy-scheduled), so renders progress while the client is idle.
@@ -62,17 +68,44 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="max jobs holding live film state (0 = unbounded)",
     )
     p.add_argument("--spool", default="", help="checkpoint spool directory")
+    p.add_argument(
+        "--slo-depth", default="",
+        help="per-priority-class queue-depth SLO spec ('8' or '0=4,5=32'; "
+        "overrides TPU_PBRT_SERVE_SLO_DEPTH) — over-target submits shed",
+    )
+    p.add_argument(
+        "--slo-wait-s", default="",
+        help="per-class p90 queue-wait SLO spec in seconds (overrides "
+        "TPU_PBRT_SERVE_SLO_WAIT_S); evaluated over recent waits while "
+        "the class has queued work",
+    )
+    p.add_argument(
+        "--metrics-path", default="",
+        help="write the Prometheus metrics snapshot here on shutdown "
+        "(also settable via TPU_PBRT_METRICS_PATH)",
+    )
     p.add_argument("--quiet", action="store_true")
     return p
 
 
 def _make_service(args):
     from tpu_pbrt.parallel.mesh import resolve_mesh
-    from tpu_pbrt.serve import RenderService
+    from tpu_pbrt.serve import RenderService, SloPolicy, parse_slo_spec
 
     mesh_shape = (
         tuple(int(x) for x in args.mesh.split(",")) if args.mesh else None
     )
+    slo = None
+    if getattr(args, "slo_depth", "") or getattr(args, "slo_wait_s", ""):
+        base = SloPolicy.from_cfg()
+        slo = SloPolicy(
+            depth=parse_slo_spec(args.slo_depth, int) or base.depth,
+            wait_s=parse_slo_spec(args.slo_wait_s, float) or base.wait_s,
+        )
+    if getattr(args, "metrics_path", ""):
+        from tpu_pbrt.obs.metrics import METRICS
+
+        METRICS.configure(args.metrics_path)
     return RenderService(
         mesh=resolve_mesh(mesh_shape),
         chunk=args.chunk or None,
@@ -83,6 +116,7 @@ def _make_service(args):
         seed=args.seed,
         spool_dir=args.spool or None,
         quiet=True,
+        slo=slo,
     )
 
 
@@ -97,6 +131,8 @@ def _emit(out, payload):
 
 
 def _handle(service, req, out):
+    from tpu_pbrt.serve import ShedError
+
     op = req.get("op")
     try:
         if op == "submit":
@@ -110,21 +146,32 @@ def _handle(service, req, out):
                 ),
                 image_file=req.get("outfile", ""),
             )
-            job = service.submit(
-                req.get("scene"),
-                text=req.get("text"),
-                options=opts,
-                job_id=req.get("job"),
-                tenant=req.get("tenant", "default"),
-                priority=int(req.get("priority", 0)),
-                weight=req.get("weight"),
-                chunk=int(req["chunk"]) if req.get("chunk") else None,
-                checkpoint_path=req.get("checkpoint", ""),
-                checkpoint_every=int(req.get("checkpoint_every", 0)),
-                preview_every=int(req.get("preview_every", 0)),
-                preview_path=req.get("preview", ""),
-                outfile=req.get("outfile", ""),
-            )
+            try:
+                job = service.submit(
+                    req.get("scene"),
+                    text=req.get("text"),
+                    options=opts,
+                    job_id=req.get("job"),
+                    tenant=req.get("tenant", "default"),
+                    priority=int(req.get("priority", 0)),
+                    weight=req.get("weight"),
+                    chunk=int(req["chunk"]) if req.get("chunk") else None,
+                    checkpoint_path=req.get("checkpoint", ""),
+                    checkpoint_every=int(req.get("checkpoint_every", 0)),
+                    preview_every=int(req.get("preview_every", 0)),
+                    preview_path=req.get("preview", ""),
+                    outfile=req.get("outfile", ""),
+                )
+            except ShedError as e:
+                # SLO load shedding: a first-class protocol answer, not
+                # an error string — clients branch on "shed" to retry
+                # elsewhere/later (nothing was compiled or queued)
+                _emit(out, {
+                    "ok": False, "op": op, "shed": True,
+                    "tenant": e.tenant, "priority": e.priority,
+                    "reason": e.reason,
+                })
+                return None
             _emit(out, {"ok": True, "op": op, "job": job})
         elif op == "poll":
             _emit(out, {"ok": True, "op": op, **service.poll(req["job"])})
@@ -164,6 +211,24 @@ def _handle(service, req, out):
             })
         elif op == "stats":
             _emit(out, {"ok": True, "op": op, **_json_safe(service.stats())})
+        elif op == "metrics":
+            # Prometheus text exposition of the process registry — the
+            # scrape endpoint, JSONL-framed. "out" additionally writes
+            # the page to a file (the --metrics-path snapshot shape).
+            text = service.metrics_exposition()
+            path = req.get("out", "")
+            written = None
+            if path and text:
+                from tpu_pbrt.obs.metrics import METRICS
+
+                written = METRICS.export(path)
+            # "out" reports what was actually WRITTEN — an empty page
+            # (kill switch / nothing recorded) skips the export, and the
+            # client must not be told a snapshot file exists
+            _emit(out, {
+                "ok": True, "op": op, "exposition": text,
+                "lines": len(text.splitlines()), "out": written,
+            })
         elif op == "shutdown":
             return "drain" if req.get("drain", True) else "now"
         else:
@@ -365,6 +430,54 @@ def selftest(args) -> int:
     if service.residency.get(service.jobs[j4].resident_key).pins != 0:
         fails.append("cancel left the residency pin held")
 
+    # SLO load shedding (ISSUE 10): with a class queue-depth target of 1,
+    # an over-SLO submit burst is answered with deterministic sheds —
+    # counted, before any compile or queue mutation. After the admitted
+    # job leaves the queue, admission opens again.
+    from tpu_pbrt.serve import ShedError, SloPolicy, parse_slo_spec
+
+    say("slo shed burst (depth target 1)")
+    service.slo = SloPolicy(depth=parse_slo_spec("1", int))
+    burst_ok, burst_shed = [], 0
+    for _ in range(4):
+        try:
+            burst_ok.append(
+                service.submit(text=text, options=opts, tenant="burst")
+            )
+        except ShedError:
+            burst_shed += 1
+    if len(burst_ok) != 1 or burst_shed != 3 or service.sheds != 3:
+        fails.append(
+            f"shed burst not deterministic: {len(burst_ok)} admitted, "
+            f"{burst_shed} shed (counted {service.sheds})"
+        )
+    service.cancel(burst_ok[0])
+    try:
+        service.cancel(service.submit(text=text, options=opts,
+                                      tenant="burst"))
+    except ShedError:
+        fails.append("submit still shed after the queue drained")
+    service.slo = SloPolicy()
+
+    # metrics exposition (ISSUE 10): the scrape page must lint clean and
+    # carry the per-tenant queue-wait/service-time histograms + the shed
+    # counter the burst above just incremented
+    from tpu_pbrt.obs.metrics import METRICS, validate_exposition
+
+    if METRICS.enabled:
+        exp = service.metrics_exposition()
+        errs = validate_exposition(exp)
+        fails += [f"exposition: {e}" for e in errs]
+        for needle in (
+            "tpu_pbrt_serve_queue_wait_seconds_bucket",
+            "tpu_pbrt_serve_slice_seconds_count",
+            'tenant="alice"',
+            "tpu_pbrt_serve_shed_total",
+            "tpu_pbrt_residency_hits_total",
+        ):
+            if needle not in exp:
+                fails.append(f"exposition missing {needle}")
+
     line = {
         "selftest": "tpu_pbrt.serve",
         "ok": not fails,
@@ -374,6 +487,7 @@ def selftest(args) -> int:
         "residency_hits": res_stats["hits"],
         "preemptions": service.poll(j2)["preemptions"],
         "previews": service.poll(j1)["previews"],
+        "sheds": service.sheds,
     }
     if fails:
         line["failures"] = fails
@@ -387,7 +501,14 @@ def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
     if args.selftest:
         return selftest(args)
-    return run_daemon(_make_service(args))
+    try:
+        return run_daemon(_make_service(args))
+    finally:
+        from tpu_pbrt.obs.metrics import METRICS
+
+        # --metrics-path / TPU_PBRT_METRICS_PATH: the final scrape
+        # snapshot survives the daemon exiting
+        METRICS.maybe_export()
 
 
 if __name__ == "__main__":

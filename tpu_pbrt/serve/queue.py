@@ -31,8 +31,8 @@ rendered films and replay a production schedule from its log.
 from __future__ import annotations
 
 import zlib
-from dataclasses import dataclass
-from typing import Dict, Iterable, Optional
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Optional, Tuple
 
 
 @dataclass
@@ -122,6 +122,88 @@ class FairScheduler:
             }
             for name, ts in sorted(self._tenants.items())
         }
+
+
+# --------------------------------------------------------------------------
+# SLO admission control (ISSUE 10: ROADMAP #2's load-shedding item)
+# --------------------------------------------------------------------------
+
+
+def parse_slo_spec(spec: str, cast) -> Dict[Optional[int], float]:
+    """`TPU_PBRT_SERVE_SLO_*` spec grammar -> {priority class: target}.
+    A bare value ("8") or `default=8` sets the every-class default (the
+    None key); `0=4,5=32` sets per-class targets. Raises on anything
+    else — a silently ignored SLO knob is the worst failure mode an
+    admission-control config can have."""
+    out: Dict[Optional[int], float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, eq, v = part.partition("=")
+        if not eq:
+            out[None] = cast(k)
+        elif k.strip().lower() in ("default", "*"):
+            out[None] = cast(v)
+        else:
+            out[int(k)] = cast(v)
+    return out
+
+
+@dataclass
+class SloPolicy:
+    """Per-priority-class admission targets. The shed decision is a PURE
+    function of (class, queued depth, observed wait p90) — no wall
+    clock, no randomness — so an over-SLO submit burst sheds the same
+    requests every run (the determinism contract the scheduler already
+    keeps, extended to admission)."""
+
+    #: class -> max runnable jobs before a submit sheds (None key = default)
+    depth: Dict[Optional[int], float] = field(default_factory=dict)
+    #: class -> max observed p90 queue wait (seconds) before a submit sheds
+    wait_s: Dict[Optional[int], float] = field(default_factory=dict)
+
+    @classmethod
+    def from_cfg(cls) -> "SloPolicy":
+        from tpu_pbrt.config import cfg
+
+        return cls(
+            depth=parse_slo_spec(cfg.serve_slo_depth, int),
+            wait_s=parse_slo_spec(cfg.serve_slo_wait_s, float),
+        )
+
+    def enabled(self) -> bool:
+        return bool(self.depth or self.wait_s)
+
+    def depth_target(self, priority: int) -> Optional[int]:
+        t = self.depth.get(int(priority), self.depth.get(None))
+        return None if t is None else int(t)
+
+    def wait_target(self, priority: int) -> Optional[float]:
+        t = self.wait_s.get(int(priority), self.wait_s.get(None))
+        return None if t is None else float(t)
+
+    def admit(
+        self, priority: int, queued_depth: int,
+        wait_p90: Optional[float] = None,
+    ) -> Tuple[bool, str]:
+        """(admit?, shed reason). queued_depth counts the class's
+        runnable jobs BEFORE this submit; wait_p90 is the class's
+        observed p90 queue wait (None = no observations yet — never a
+        shed reason on its own: an idle service must accept work)."""
+        d = self.depth_target(priority)
+        if d is not None and queued_depth >= d:
+            return False, (
+                f"queue depth {queued_depth} at class-{priority} "
+                f"target {d}"
+            )
+        w = self.wait_target(priority)
+        if w is not None and wait_p90 is not None and wait_p90 > w:
+            return False, (
+                f"queue-wait p90 {wait_p90:.3f}s over class-{priority} "
+                f"target {w:g}s"
+            )
+        return True, ""
 
 
 def preemption_victim(active_jobs: Iterable, candidate) -> Optional[object]:

@@ -42,6 +42,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from tpu_pbrt.obs.metrics import METRICS
+
 
 def scene_hbm_bytes(scene) -> int:
     """Device-resident footprint of a compiled scene: every array leaf
@@ -134,6 +136,10 @@ class ResidencyCache:
         if ent is not None:
             ent.hits += 1
             self.hits += 1
+            METRICS.counter(
+                "residency_hits_total",
+                "submits served from a resident compiled scene",
+            ).inc()
             self._touch(ent)
             return ent
         import time
@@ -141,6 +147,10 @@ class ResidencyCache:
         t0 = time.time()
         scene, integ = builder()
         self.scene_compiles += 1
+        METRICS.counter(
+            "residency_misses_total",
+            "submits that paid a scene compile",
+        ).inc()
         ent = ResidentScene(
             key=key, scene=scene, integrator=integ,
             hbm_bytes=scene_hbm_bytes(scene),
@@ -187,6 +197,7 @@ class ResidencyCache:
         number of entries evicted. Dropping the entry releases the last
         strong refs to scene.dev and the integrator's jit closure — jax
         frees the device buffers when the arrays are collected."""
+        self._footprint_gauges()
         if self.max_bytes is None:
             return 0
         n = 0
@@ -199,8 +210,25 @@ class ResidencyCache:
             coldest = min(victims, key=lambda e: e.last_used)
             del self._entries[coldest.key]
             self.evictions += 1
+            METRICS.counter(
+                "residency_evicted_bytes_total",
+                "HBM bytes reclaimed by LRU scene eviction",
+            ).inc(coldest.hbm_bytes)
             n += 1
+        if n:
+            self._footprint_gauges()
         return n
+
+    def _footprint_gauges(self) -> None:
+        if not METRICS.enabled:
+            return
+        METRICS.gauge(
+            "residency_resident_bytes",
+            "HBM footprint of the resident compiled scenes",
+        ).set(self.total_bytes())
+        METRICS.gauge(
+            "residency_entries", "resident compiled scenes"
+        ).set(len(self._entries))
 
     def release(self, key: str) -> bool:
         """Drop an entry outright regardless of LRU order (explicit
@@ -210,6 +238,11 @@ class ResidencyCache:
             return False
         del self._entries[key]
         self.evictions += 1
+        METRICS.counter(
+            "residency_evicted_bytes_total",
+            "HBM bytes reclaimed by LRU scene eviction",
+        ).inc(ent.hbm_bytes)
+        self._footprint_gauges()
         return True
 
     # -- introspection -----------------------------------------------------

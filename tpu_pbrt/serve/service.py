@@ -65,7 +65,8 @@ from tpu_pbrt.parallel.checkpoint import (
     load_checkpoint,
     save_checkpoint,
 )
-from tpu_pbrt.serve.queue import FairScheduler, preemption_victim
+from tpu_pbrt.obs.metrics import METRICS
+from tpu_pbrt.serve.queue import FairScheduler, SloPolicy, preemption_victim
 from tpu_pbrt.serve.residency import (
     ResidencyCache,
     scene_source_key,
@@ -83,6 +84,58 @@ CANCELLED = "cancelled"
 FAILED = "failed"
 _TERMINAL = (DONE, CANCELLED, FAILED)
 _RUNNABLE = (QUEUED, ACTIVE, PARKED)
+
+
+class ShedError(RuntimeError):
+    """A submit was load-shed by the SLO admission policy (ISSUE 10 /
+    ROADMAP #2): the priority class's queue-depth or queue-wait target
+    was already breached, so queuing more work would only deepen the
+    breach. The request was NOT queued — the caller should retry later
+    or against another service. Deterministic: the same submit burst
+    against the same service state sheds the same requests."""
+
+    def __init__(self, msg: str, *, tenant: str, priority: int, reason: str):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.priority = priority
+        self.reason = reason
+
+
+# NOTE on labels: tenant/priority only — never job ids. A long-lived
+# daemon processes unbounded jobs, and histogram series are permanent;
+# per-job detail belongs to the per-job flight files, not the registry.
+def _queue_wait_hist():
+    return METRICS.histogram(
+        "serve_queue_wait_seconds",
+        "seconds a runnable job waited for its next chunk-slice dispatch "
+        "(labels: tenant, priority)",
+    )
+
+
+def _slice_hist():
+    return METRICS.histogram(
+        "serve_slice_seconds",
+        "chunk-slice service time: dispatch through bookkeeping "
+        "(labels: tenant)",
+    )
+
+
+#: recent queue waits kept per priority class for the wait-SLO signal
+_WAIT_WINDOW = 32
+
+
+def _window_p90(window) -> Optional[float]:
+    """Nearest-rank p90 over the bounded recent-wait window — exact and
+    deterministic given the recorded waits (no buckets needed at n<=32).
+    Nearest-rank: the ceil(0.9*n)-th smallest (1-based), so at n=20 the
+    18th sample decides — not the 19th, which would let 2 outliers in a
+    window of 20 shed a class whose p90 is actually under target."""
+    if not window:
+        return None
+    import math
+
+    w = sorted(window)
+    return w[max(math.ceil(0.9 * len(w)) - 1, 0)]
 
 
 @dataclass
@@ -126,6 +179,10 @@ class RenderJob:
     restarts: int = 0
     preemptions: int = 0
     previews: int = 0
+    #: wall clock at which the job last became dispatchable (submit,
+    #: slice completion, resume, recovery) — queue wait is measured from
+    #: here to the next dispatch, per slice
+    ready_t: float = 0.0
     active_seconds: float = 0.0
     error: str = ""
     result: Optional[RenderResult] = None
@@ -192,6 +249,7 @@ class RenderService:
         seed: int = 0,
         spool_dir: Optional[str] = None,
         quiet: bool = True,
+        slo: Optional[SloPolicy] = None,
     ):
         self.mesh = mesh
         if chunk is None:
@@ -223,6 +281,19 @@ class RenderService:
                 "TPU_PBRT_TELEMETRY=0 disabled them; re-enable telemetry "
                 "or use the default scrub mode"
             )
+        # SLO admission control (ISSUE 10): per-class depth/wait targets
+        # from TPU_PBRT_SERVE_SLO_* (or injected). The wait signal is a
+        # BOUNDED in-service window of recent per-class queue waits —
+        # not the registry's lifetime-cumulative histogram, whose p90
+        # can never recover once elevated (shed submits produce no new
+        # samples: a permanent lockout); the registry histogram remains
+        # the exported observability surface. Works with
+        # TPU_PBRT_METRICS=0 too (the window is service state).
+        self.slo = slo if slo is not None else SloPolicy.from_cfg()
+        self._recent_waits: Dict[int, Any] = {}
+        #: submits answered with a shed (the deterministic count the
+        #: selftest pins; the labeled breakdown lives in the registry)
+        self.sheds = 0
         #: the dispatch record [(job_id, chunk_index), ...] — the
         #: deterministic-interleaving evidence tests assert on
         self.schedule: List[tuple] = []
@@ -250,9 +321,17 @@ class RenderService:
         """Submit a render: a .pbrt file `path`, inline scene `text`, or
         a precompiled (scene, integrator) pair. Returns the job id.
         Scene compilation happens HERE (once per resident key — a warm
-        key is a cache hit); no rendering happens until `step`."""
+        key is a cache hit); no rendering happens until `step`.
+
+        Raises ShedError WITHOUT compiling or queuing anything when the
+        SLO admission policy says the request's priority class is
+        already over its queue-depth or queue-wait target — shedding
+        after the compile would spend the exact resources shedding
+        exists to protect."""
         from tpu_pbrt.obs.trace import TRACE
 
+        if self.slo.enabled():
+            self._admit_or_shed(tenant, int(priority))
         if options is None:
             from tpu_pbrt.scene.api import Options
 
@@ -334,10 +413,71 @@ class RenderService:
                 if j.status in _RUNNABLE
             },
         )
+        job.ready_t = time.time()
         self.jobs[job_id] = job
+        METRICS.counter(
+            "serve_submits_total", "jobs admitted by submit"
+        ).inc(tenant=tenant)
+        self._update_depth_gauge()
         self._flight(job, "serve_submit", key=key, tenant=tenant,
                      priority=job.priority)
         return job_id
+
+    def _admit_or_shed(self, tenant: str, priority: int) -> None:
+        """The SLO admission decision — a pure function of the current
+        job table (class queue depth) and the registry's observed
+        queue-wait p90 for the class. Breach -> counted + flight-logged
+        ShedError; the request never touches the compiler or the
+        queue."""
+        depth = sum(
+            1 for j in self.jobs.values()
+            if j.status in _RUNNABLE and j.priority == priority
+        )
+        # the wait signal is consulted only while the class actually has
+        # queued work: with an empty queue the recorded waits are stale
+        # congestion, and admitting is what produces the fresh samples
+        # that let the signal recover (no-lockout property, pinned by
+        # tests/test_serve.py)
+        wait_p90 = None
+        if depth > 0 and self.slo.wait_target(priority) is not None:
+            wait_p90 = _window_p90(self._recent_waits.get(priority))
+        ok, reason = self.slo.admit(priority, depth, wait_p90)
+        if ok:
+            return
+        self.sheds += 1
+        METRICS.counter(
+            "serve_shed_total",
+            "submits answered with a shed by SLO admission control",
+        ).inc(tenant=tenant, priority=priority)
+        from tpu_pbrt.obs.flight import FLIGHT
+
+        FLIGHT.heartbeat(
+            "serve_shed", tenant=tenant, priority=priority, reason=reason,
+        )
+        raise ShedError(
+            f"submit shed: {reason}", tenant=tenant, priority=priority,
+            reason=reason,
+        )
+
+    def _update_depth_gauge(self) -> None:
+        """Per-priority-class runnable-job depth — the gauge a monitor
+        alarms on before the shed counter starts climbing."""
+        if not METRICS.enabled:
+            return
+        g = METRICS.gauge(
+            "serve_queue_depth",
+            "runnable jobs per priority class (labels: priority)",
+        )
+        depths: Dict[int, int] = {}
+        for j in self.jobs.values():
+            if j.status in _RUNNABLE:
+                depths[j.priority] = depths.get(j.priority, 0) + 1
+        seen = {ls.get("priority") for ls in g.labelsets()}
+        for prio, n in depths.items():
+            g.set(n, priority=prio)
+        for prio in seen - {str(p) for p in depths}:
+            if prio is not None:
+                g.set(0, priority=prio)
 
     # -- the scheduler step -------------------------------------------------
     def _runnable(self) -> List[RenderJob]:
@@ -390,6 +530,7 @@ class RenderService:
                 job.error = job.error or f"{type(e).__name__}: {e}"
             job.state = None
             self.residency.unpin(job.resident_key)
+            self._update_depth_gauge()
             self._flight(job, "serve_failed", error=str(job.error)[:200])
         return job.job_id
 
@@ -419,6 +560,7 @@ class RenderService:
         if job.state is not None:
             self._park(job)
         job.status = PAUSED
+        self._update_depth_gauge()  # PAUSED is not runnable
         self._flight(job, "serve_preempt", chunk=job.cursor)
 
     def resume(self, job_id: str) -> None:
@@ -426,6 +568,11 @@ class RenderService:
         if job.status != PAUSED:
             raise ValueError(f"job {job_id} is {job.status}, not paused")
         job.status = PARKED if job.cursor else QUEUED
+        job.ready_t = time.time()
+        METRICS.counter(
+            "serve_resumes_total", "paused jobs resumed"
+        ).inc(tenant=job.tenant)
+        self._update_depth_gauge()
         self._flight(job, "serve_resume", chunk=job.cursor)
 
     def cancel(self, job_id: str) -> None:
@@ -442,6 +589,7 @@ class RenderService:
         self.residency.evict_over_budget()
         if job.spool_ckpt:
             delete_checkpoint(job.checkpoint_path)
+        self._update_depth_gauge()
         self._flight(job, "serve_cancel", chunk=job.cursor)
 
     def poll(self, job_id: str) -> Dict[str, Any]:
@@ -489,7 +637,15 @@ class RenderService:
             "residency": self.residency.stats(),
             "tenants": self.scheduler.stats(),
             "schedule_len": len(self.schedule),
+            "sheds": self.sheds,
         }
+
+    def metrics_exposition(self) -> str:
+        """The registry's Prometheus text page — what the daemon's
+        `metrics` verb and `--metrics-path` snapshots serve. Empty when
+        TPU_PBRT_METRICS=0 (the kill switch leaves responses with
+        nothing to report, not stale data)."""
+        return METRICS.exposition() if METRICS.enabled else ""
 
     # -- internals -----------------------------------------------------------
     def _job(self, job_id: str) -> RenderJob:
@@ -580,6 +736,10 @@ class RenderService:
         job.nf_counts.clear()
         job.state = None
         job.preemptions += 1
+        METRICS.counter(
+            "serve_preemptions_total",
+            "jobs parked via the emergency-checkpoint path",
+        ).inc(tenant=job.tenant)
         self._flight(job, "serve_park", chunk=job.cursor)
 
     def _dispatch_slice(self, job: RenderJob) -> None:
@@ -592,6 +752,23 @@ class RenderService:
         plan = job.plan
         c = job.cursor
         t0 = time.time()
+        if job.ready_t:
+            # queue wait: became-dispatchable -> this dispatch (includes
+            # scheduler contention and any backoff window — the latency
+            # the tenant actually observes, which is what the SLO wait
+            # target bounds)
+            wait = t0 - job.ready_t
+            _queue_wait_hist().observe(
+                wait, tenant=job.tenant, priority=job.priority,
+            )
+            win = self._recent_waits.get(job.priority)
+            if win is None:
+                from collections import deque
+
+                win = self._recent_waits[job.priority] = deque(
+                    maxlen=_WAIT_WINDOW
+                )
+            win.append(wait)
         try:
             CHAOS.dispatch(c, job.attempt, mesh=self.mesh is not None)
             try:
@@ -627,7 +804,10 @@ class RenderService:
         job.attempt = 0
         job.state = state
         job.cursor = c + 1
-        job.active_seconds += time.time() - t0
+        now = time.time()
+        job.active_seconds += now - t0
+        _slice_hist().observe(now - t0, tenant=job.tenant)
+        job.ready_t = now
         self.schedule.append((job.job_id, c))
         self.scheduler.charge(job.tenant)
         nrays, occ, ctr, spread, nf = plan.aux_parts(aux)
@@ -664,6 +844,7 @@ class RenderService:
             job.error = f"chunk {job.cursor} failed {job.attempt} times: {e}"
             job.state = None
             self.residency.unpin(job.resident_key)
+            self._update_depth_gauge()
             self._flight(job, "serve_failed", error=job.error[:200])
             return
         if e.poisons_state:
@@ -683,6 +864,14 @@ class RenderService:
             job.nf_counts.clear()
             job.status = PARKED  # re-activation reloads/re-inits state
         backoff = redispatch_backoff(job.cursor, job.attempt)
+        METRICS.counter(
+            "serve_redispatches_total", "chunk-slice re-dispatches"
+        ).inc(tenant=job.tenant)
+        METRICS.counter(
+            "serve_redispatch_backoff_seconds_total",
+            "seconds of re-dispatch backoff accrued",
+        ).inc(backoff, tenant=job.tenant)
+        job.ready_t = time.time()
         self._flight(
             job, "serve_redispatch", chunk=job.cursor,
             attempt=job.attempt, poisoned=e.poisons_state,
@@ -699,6 +888,7 @@ class RenderService:
         from tpu_pbrt.obs.trace import TRACE
         from tpu_pbrt.utils import imageio
 
+        t0 = time.time()
         with TRACE.span("serve/preview", job=job.job_id, chunk=job.cursor):
             img = self.preview(job.job_id)
             try:
@@ -708,6 +898,10 @@ class RenderService:
                 from tpu_pbrt.utils.error import Warning as _W
 
                 _W(f"preview write failed for {job.job_id}: {ex}")
+        METRICS.histogram(
+            "serve_preview_seconds",
+            "preview latency: live-film develop + image write",
+        ).observe(time.time() - t0, tenant=job.tenant)
         self._flight(job, "serve_preview", chunk=job.cursor)
 
     def _finalize(self, job: RenderJob) -> None:
@@ -778,5 +972,6 @@ class RenderService:
         self.residency.evict_over_budget()
         if job.spool_ckpt:
             delete_checkpoint(job.checkpoint_path)
+        self._update_depth_gauge()
         self._flight(job, "serve_done", rays=rays,
                      seconds=round(job.active_seconds, 3))
