@@ -218,7 +218,11 @@ def static_wave_cost(res: int, spp: int, timeout_s: float = 150.0) -> dict:
             return {
                 k: d[k]
                 for k in ("static_flops_per_wave", "static_bytes_per_wave",
-                          "static_intensity")
+                          "static_intensity",
+                          # pallascheck's fused-kernel VMEM footprint +
+                          # budget headroom fraction (ISSUE 11) — absent
+                          # from pre-PR-11 subprocess output, tolerated
+                          "static_vmem_per_wave", "vmem_headroom")
                 if k in d
             }
         print(

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
-# Local CI gate (ISSUE 2 + ISSUE 3 satellites):
+# Local CI gate (ISSUE 2 + ISSUE 3 + ISSUE 11 satellites):
 #   ruff -> jaxlint (AST) -> jaxpr audit + jaxcost budget gate + shardcheck
-#   -> tier-1 pytest.
+#   + pallascheck VMEM/grid-semantics gate -> tier-1 pytest.
 #
 #   tools/ci.sh            # full gate
 #   tools/ci.sh --fast     # skip the pytest leg (lint + audit + gates only)
@@ -31,10 +31,17 @@ fi
 # fail-FAST stage: the AST lint costs ~2 s with no jax import; a lint
 # error aborts here before the multi-minute trace/compile stages below
 # (which re-lint — the duplication is the price of the early exit)
-echo "== jaxlint AST layer (python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck)"
-python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck
+echo "== jaxlint AST layer (python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck --no-pallascheck)"
+python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck --no-pallascheck
 
-echo "== jaxpr audit + jaxcost budget gate + shardcheck (python -m tpu_pbrt.analysis)"
+# the full analysis stage runs every layer and reports EVERY failing
+# stage before exiting non-zero (ISSUE 11 satellite). pallascheck gates
+# the fused kernels' per-grid-step VMEM footprints against the
+# committed vmem_budgets.json, verifies grid semantics (PC-RACE/
+# PC-INIT/PC-OOB) and re-derives the fused caps from the VMEM model
+# (PC-CAPS); after an INTENTIONAL kernel change refresh BOTH budget
+# files with `python -m tpu_pbrt.analysis --update-budgets`.
+echo "== jaxpr audit + jaxcost budget gate + shardcheck + pallascheck (python -m tpu_pbrt.analysis)"
 python -m tpu_pbrt.analysis
 
 # telemetry smoke (ISSUE 4): render a cropped cornell through the real

@@ -44,16 +44,24 @@ in-kernel forms safe:
 
 TPU grid steps execute sequentially, which is what makes the
 accumulator outputs (constant index_map -> block revisiting keeps them
-in VMEM across the whole grid) and the ordered merge sound. Interpret
-mode (`interpret=True` on CPU backends) preserves the same sequential
-semantics — that is the CPU testing story.
+in VMEM across the whole grid) and the ordered merge sound. Both
+pallas_calls DECLARE that requirement (`dimension_semantics =
+("arbitrary",)` below): a dim flipped to "parallel" would let megacore
+interleave grid steps across cores and silently race the accumulator
+merge — pallascheck's PC-RACE rule fails the repo gate on exactly that
+flip, and PC-INIT pins the `@pl.when(b == 0)` accumulator seed.
+Interpret mode (`interpret=True` on CPU backends) preserves the same
+sequential semantics — that is the CPU testing story.
 
-VMEM budget per flush grid (f32/i32, L = leaf tris, R = wave rays):
-feature row 16*4L*4 B (double-buffered), phi + out4 scratch ~ (16 + 4L)
-* 128 * 4 B, block tables (1, 128) * 2, ray table 32R B, accumulators
-8R B. At L = 512, R = 2^18: ~0.5 MB + 1 MB + 8 MB + 2 MB ~= 11.5 MB of
-the ~16 MB/core — why TPU_PBRT_FUSED_MAX_RAYS caps the fused path at
-2^18 rays and bigger waves fall back to the jnp path.
+VMEM budgets are no longer hand-derived here: the per-grid-step
+footprint of every kernel (double-buffered moving blocks + resident
+accumulators + flat scratch) is computed statically by
+`tpu_pbrt/analysis/pallascheck.py`, gated against the committed
+`analysis/vmem_budgets.json`, and INVERTED to derive the maximal safe
+caps — `python -m tpu_pbrt.analysis.pallascheck --derive-caps` prints
+the maximal TPU_PBRT_FUSED_MAX_RAYS / MAX_NODES per platform VMEM
+size; the config.py defaults (2^18 rays, 2^14 nodes) are a checked
+consequence of that model (PC-CAPS), not folklore.
 """
 
 from __future__ import annotations
@@ -77,10 +85,28 @@ EXPAND_TILE = 1024
 
 _I32_MAX = np.int32(2**31 - 1)
 
+#: Mosaic dimension semantics for the two 1-D grids. "arbitrary" =
+#: sequential execution in grid order — the property BOTH correctness
+#: proofs above rest on (the ordered closest-hit merge and the b == 0
+#: accumulator seed). Declared explicitly (not left to the Mosaic
+#: default) so pallascheck's PC-RACE rule verifies it per kernel;
+#: flipping either to ("parallel",) fails `python -m tpu_pbrt.analysis`.
+FLUSH_DIM_SEMANTICS = ("arbitrary",)
+EXPAND_DIM_SEMANTICS = ("arbitrary",)
+
 
 # --------------------------------------------------------------------------
 # FLUSH: phi build + treelet DMA + MT matmul + decode + closest-hit merge
 # --------------------------------------------------------------------------
+
+
+def _seed_accumulators(t_in_ref, p_in_ref, t_out_ref, p_out_ref):
+    """Seed the VMEM-resident winner accumulators from the wave's
+    current (t, prim) — must run on grid step 0, before any merge reads
+    them (pallascheck PC-INIT fails the repo gate if this goes missing);
+    they are written back to HBM only once, after the last grid step."""
+    t_out_ref[...] = t_in_ref[...]
+    p_out_ref[...] = p_in_ref[...]
 
 
 def _flush_kernel(meta_ref, feat_ref, rid_ref, rayF_ref, t_in_ref,
@@ -95,11 +121,7 @@ def _flush_kernel(meta_ref, feat_ref, rid_ref, rayF_ref, t_in_ref,
 
     @pl.when(b == 0)
     def _():
-        # seed the VMEM-resident winner accumulators from the wave's
-        # current (t, prim); they are written back to HBM only once,
-        # after the last grid step
-        t_out_ref[...] = t_in_ref[...]
-        p_out_ref[...] = p_in_ref[...]
+        _seed_accumulators(t_in_ref, p_in_ref, t_out_ref, p_out_ref)
 
     @pl.when(meta_ref[b, 5] > 0)
     def _():
@@ -160,7 +182,11 @@ def _flush_kernel(meta_ref, feat_ref, rid_ref, rayF_ref, t_in_ref,
 
         def lane(i, carry):
             r = rid_ref[0, i]
-            rc = jnp.maximum(r, 0)
+            # clamp BOTH ends: ray ids are < R by construction (and the
+            # store is r >= 0 guarded), so the clip is value-identical —
+            # it exists so pallascheck's PC-OOB interval proof closes on
+            # the meta-driven accumulator indexing below
+            rc = jnp.clip(r, 0, t_out_ref.shape[1] - 1)
             tc = t_scr[0, i]
             cur = t_out_ref[0, rc]
 
@@ -225,6 +251,9 @@ def fused_flush_chunk(feat_table, meta, rid_rows, rayF, t_row, prim,
             jax.ShapeDtypeStruct((1, R), jnp.float32),
             jax.ShapeDtypeStruct((1, R), jnp.int32),
         ],
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=FLUSH_DIM_SEMANTICS,
+        ),
         interpret=interpret,
     )(meta, feat_table, rid_rows, rayF, t2, p2)
     return t_out[0], p_out[0]
@@ -375,6 +404,9 @@ def fused_expand(key_in, node, rayE, prim, tab64, box48, cid,
             jax.ShapeDtypeStruct((8, sp), jnp.int32),
             jax.ShapeDtypeStruct((1, sp), jnp.int32),
         ],
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=EXPAND_DIM_SEMANTICS,
+        ),
         interpret=interpret,
     )(*args)
     return key8, cand8, live[0]

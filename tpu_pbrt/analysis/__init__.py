@@ -1,6 +1,6 @@
 """jaxlint — repo-specific static analysis + jaxpr audit for TPU hot paths.
 
-Four layers (ISSUE 2 + ISSUE 3):
+Five layers (ISSUE 2 + ISSUE 3 + ISSUE 11):
 
 - **Layer 1 (AST lint, `lint.py`)**: syntactic rules over the source tree.
   A per-module call graph seeded at `jax.jit` / `lax.while_loop` /
@@ -32,9 +32,20 @@ Four layers (ISSUE 2 + ISSUE 3):
   loop — restoring (and exceeding) the native check_rep/check_vma that
   SHARD_MAP_NOCHECK disables on jax versions where it is broken.
 
+- **Layer 5 (Pallas VMEM + grid semantics, `pallascheck.py`)**: extracts
+  every pallas_call from the fused entry points, computes the exact
+  per-grid-step VMEM footprint (double-buffered moving blocks, resident
+  constant-index_map blocks, flat scratch), gates it against the
+  committed `vmem_budgets.json` and platform VMEM capacity, DERIVES the
+  maximal safe TPU_PBRT_FUSED_MAX_RAYS/MAX_NODES from the model
+  (`--derive-caps`), and abstract-interprets the kernel bodies with
+  intervals over program_id to prove the accumulator pattern sound:
+  no parallel-dim revisited output (PC-RACE), no read before the
+  grid-step-0 seed (PC-INIT), no unprovable dynamic ref index (PC-OOB).
+
 Run `python -m tpu_pbrt.analysis` (see `__main__.py`), or the pytest
-mirrors in tests/test_jaxlint.py, test_jaxpr_audit.py, test_cost.py and
-test_shardcheck.py.
+mirrors in tests/test_jaxlint.py, test_jaxpr_audit.py, test_cost.py,
+test_shardcheck.py and test_pallascheck.py.
 """
 
 from tpu_pbrt.analysis.lint import (  # noqa: F401

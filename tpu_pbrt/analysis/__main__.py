@@ -8,9 +8,15 @@ Stages (each skippable):
   skips; `--update-budgets` refreshes the committed
   `tpu_pbrt/analysis/budgets.json` instead of gating against it;
 - shardcheck replication analysis (`shardcheck.py`) —
-  `--no-shardcheck` skips.
+  `--no-shardcheck` skips;
+- layer 5, pallascheck VMEM-budget + grid-semantics verification of the
+  fused Pallas kernels (`pallascheck.py`) — `--no-pallascheck` skips;
+  `--update-budgets` also refreshes its `vmem_budgets.json`.
 
-Exit code 0 iff no error-severity findings in any stage that ran.
+Exit code 0 iff no error-severity findings in any stage that ran. A
+stage that crashes is reported as that stage's failure and the REST of
+the stages still run — a multi-stage run always reports every failing
+stage before exiting non-zero.
 """
 
 from __future__ import annotations
@@ -64,9 +70,14 @@ def main(argv=None) -> int:
         help="skip the shard_map replication analysis",
     )
     ap.add_argument(
+        "--no-pallascheck", action="store_true",
+        help="skip the Pallas VMEM-budget/grid-semantics verification",
+    )
+    ap.add_argument(
         "--update-budgets", action="store_true",
-        help="refresh tpu_pbrt/analysis/budgets.json from the current "
-             "tree instead of gating against it (commit the result)",
+        help="refresh tpu_pbrt/analysis/budgets.json AND "
+             "vmem_budgets.json from the current tree instead of gating "
+             "against them (commit the result)",
     )
     ap.add_argument("--format", choices=("text", "json"), default="text")
     args = ap.parse_args(argv)
@@ -78,41 +89,77 @@ def main(argv=None) -> int:
     violations, pragmas = lint_tree(repo_root, paths)
     over_budget = paths is None and pragmas > PRAGMA_BUDGET
 
-    need_jax = not (args.no_audit and args.no_cost and args.no_shardcheck)
+    need_jax = not (
+        args.no_audit and args.no_cost and args.no_shardcheck
+        and args.no_pallascheck
+    )
     if need_jax:
-        # CPU audit/cost/shardcheck compile or trace tiny programs; the
-        # unoptimized XLA pipeline + the repo compilation cache keep
-        # this to seconds.
+        # CPU audit/cost/shardcheck/pallascheck compile or trace tiny
+        # programs; the unoptimized XLA pipeline + the repo compilation
+        # cache keep this to seconds.
         _setup_jax_env()
 
-    audit_failures = []
-    if not args.no_audit:
-        from tpu_pbrt.analysis.audit import run_audit
+    # every stage runs inside its own guard: a stage that CRASHES is
+    # reported as that stage's failure and the remaining stages still
+    # run, so one broken layer can't hide findings from the others
+    def _stage(fn, sink):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001
+            sink.append(f"stage crashed: {type(e).__name__}: {e}")
+            return None
 
-        audit_failures = run_audit()
+    audit_failures: list = []
+    if not args.no_audit:
+        def _audit():
+            from tpu_pbrt.analysis.audit import run_audit
+
+            return run_audit()
+
+        audit_failures = _stage(_audit, audit_failures) or audit_failures
 
     cost_errors: list = []
     cost_warnings: list = []
     rollups = {}
     cost_findings: list = []
     if not args.no_cost:
-        from tpu_pbrt.analysis.cost import run_cost
+        def _cost():
+            from tpu_pbrt.analysis.cost import run_cost
 
-        cost_errors, cost_warnings, rollups, cost_findings = run_cost(
-            update=args.update_budgets
-        )
+            return run_cost(update=args.update_budgets)
+
+        out = _stage(_cost, cost_errors)
+        if out is not None:
+            cost_errors, cost_warnings, rollups, cost_findings = out
 
     shard_errors: list = []
     shard_warnings: list = []
     if not args.no_shardcheck:
-        from tpu_pbrt.analysis.shardcheck import run_shardcheck
+        def _shard():
+            from tpu_pbrt.analysis.shardcheck import run_shardcheck
 
-        shard_errors, shard_warnings = run_shardcheck()
+            return run_shardcheck()
+
+        out = _stage(_shard, shard_errors)
+        if out is not None:
+            shard_errors, shard_warnings = out
+
+    pallas_errors: list = []
+    pallas_warnings: list = []
+    if not args.no_pallascheck:
+        def _pallas():
+            from tpu_pbrt.analysis.pallascheck import run_pallascheck
+
+            return run_pallascheck(update=args.update_budgets)
+
+        out = _stage(_pallas, pallas_errors)
+        if out is not None:
+            pallas_errors, pallas_warnings = out
 
     errors = [v for v in violations if v.severity == "error"]
     ok = not (
         errors or audit_failures or over_budget or cost_errors
-        or shard_errors
+        or shard_errors or pallas_errors
     )
     if args.format == "json":
         print(
@@ -140,6 +187,10 @@ def main(argv=None) -> int:
                         "errors": shard_errors,
                         "warnings": shard_warnings,
                     },
+                    "pallascheck": {
+                        "errors": pallas_errors,
+                        "warnings": pallas_warnings,
+                    },
                     "pragmas": pragmas,
                     "pragma_budget": PRAGMA_BUDGET,
                     "ok": ok,
@@ -159,10 +210,23 @@ def main(argv=None) -> int:
             print(f"SHARDCHECK [warning]: {w}")
         for e in shard_errors:
             print(f"SHARDCHECK [error]: {e}")
+        for w in pallas_warnings:
+            print(f"PALLASCHECK [warning]: {w}")
+        for e in pallas_errors:
+            print(f"PALLASCHECK [error]: {e}")
         if args.update_budgets and not args.no_cost:
             from tpu_pbrt.analysis.cost import BUDGETS_PATH
 
             print(f"jaxcost: budgets refreshed -> {BUDGETS_PATH}")
+        if args.update_budgets and not args.no_pallascheck:
+            from tpu_pbrt.analysis.pallascheck import (
+                BUDGETS_PATH as VMEM_BUDGETS_PATH,
+            )
+
+            print(
+                f"pallascheck: VMEM budgets refreshed -> "
+                f"{VMEM_BUDGETS_PATH}"
+            )
         n_warn = len(violations) - len(errors)
         # a SKIPPED stage must not read as a clean one in the summary
         audit_part = (
@@ -177,9 +241,13 @@ def main(argv=None) -> int:
             "shardcheck skipped" if args.no_shardcheck
             else f"{len(shard_errors)} shardcheck error(s)"
         )
+        pallas_part = (
+            "pallascheck skipped" if args.no_pallascheck
+            else f"{len(pallas_errors)} pallascheck error(s)"
+        )
         print(
             f"jaxlint: {len(errors)} error(s), {n_warn} warning(s), "
-            f"{audit_part}, {cost_part}, {shard_part}, "
+            f"{audit_part}, {cost_part}, {shard_part}, {pallas_part}, "
             f"{pragmas} pragma suppression(s) (budget {PRAGMA_BUDGET})"
         )
         if over_budget:

@@ -58,6 +58,7 @@ import hashlib
 import json
 import math
 from dataclasses import dataclass
+from functools import lru_cache
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
@@ -821,6 +822,26 @@ def run_cost(
 # --------------------------------------------------------------------------
 
 
+def _bench_pool(chunk: int) -> int:
+    """The bench wave's pool-size default — ONE definition so the
+    roofline half and the VMEM half of the same --bench-wave line always
+    describe the same wave width."""
+    return max(chunk // 4, min(chunk, 4096))
+
+
+@lru_cache(maxsize=2)
+def _bench_scene(res: int, spp: int):
+    """The production-shaped killeroo-like scene, compiled once per
+    process and shared by the roofline AND VMEM halves of --bench-wave."""
+    from tpu_pbrt.scenes import compile_api, make_killeroo_like
+
+    api = make_killeroo_like(
+        res=res, spp=spp, integrator="path", maxdepth=5,
+        n_theta=24, n_phi=48,
+    )
+    return compile_api(api)
+
+
 def bench_wave_rollup(
     res: int = 512, spp: int = 256, chunk: int = 1 << 20,
     pool: Optional[int] = None,
@@ -834,16 +855,10 @@ def bench_wave_rollup(
     import jax
     import jax.numpy as jnp
 
-    from tpu_pbrt.scenes import compile_api, make_killeroo_like
-
-    api = make_killeroo_like(
-        res=res, spp=spp, integrator="path", maxdepth=5,
-        n_theta=24, n_phi=48,
-    )
-    scene, integ = compile_api(api)
+    scene, integ = _bench_scene(res, spp)
     film = scene.film
     if pool is None:
-        pool = max(chunk // 4, min(chunk, 4096))
+        pool = _bench_pool(chunk)
 
     def fn(fs, start_pix, start_s):
         return integ.pool_chunk(
@@ -856,6 +871,42 @@ def bench_wave_rollup(
     )
     roll, _ = analyze_jaxpr(jx, "bench.pool_chunk", pool)
     return roll
+
+
+def bench_wave_vmem(
+    res: int = 512, spp: int = 256, chunk: int = 1 << 20,
+    pool: Optional[int] = None,
+) -> Dict:
+    """The VMEM half of the static wave signal (ISSUE 11 satellite):
+    pallascheck's per-grid-step footprint of the fused kernels this
+    bench wave would dispatch on a TPU — camera + pending-shadow rays
+    ride ONE 2R fused wave, capped at TPU_PBRT_FUSED_MAX_RAYS (past the
+    cap the tracer falls back to jnp, so the capped width is the fused
+    operating point). `vmem_headroom` is the fraction of the model's
+    VMEM budget (headroom x smallest-platform capacity) still free —
+    negative means the wave could not compile within budget. Advisory:
+    returns {} when the scene has no stream tracer."""
+    from tpu_pbrt.analysis import pallascheck
+    from tpu_pbrt.config import cfg
+
+    scene, _ = _bench_scene(res, spp)
+    if pool is None:
+        pool = _bench_pool(chunk)
+    tp = scene.dev.get("tstream")
+    if tp is None:
+        return {}
+    R = min(2 * int(pool), int(cfg.fused_max_rays))
+    vmem = pallascheck.wave_vmem(
+        R, int(tp.top.child_idx.shape[0]),
+        motion=(tp.n_features == 64), L=tp.leaf_tris,
+    )
+    budget = int(
+        min(pallascheck.VMEM_BYTES.values()) * pallascheck.VMEM_HEADROOM
+    )
+    return {
+        "static_vmem_per_wave": vmem,
+        "vmem_headroom": round(1.0 - vmem / budget, 4),
+    }
 
 
 def _main(argv=None) -> int:
@@ -871,12 +922,21 @@ def _main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.bench_wave:
         roll = bench_wave_rollup(res=args.res, spp=args.spp)
-        print(json.dumps({
+        line = {
             "static_flops_per_wave": roll.flops,
             "static_bytes_per_wave": roll.hbm_bytes,
             "static_intensity": round(roll.intensity, 3),
             "fingerprint": roll.fingerprint,
-        }))
+        }
+        try:
+            # the VMEM half (pallascheck): advisory — the HBM roofline
+            # fields above must survive any pallascheck drift
+            line.update(bench_wave_vmem(res=args.res, spp=args.spp))
+        except Exception as e:  # noqa: BLE001
+            import sys
+
+            print(f"bench-wave vmem model failed: {e}", file=sys.stderr)
+        print(json.dumps(line))
         return 0
     errors, warnings, rollups, _ = run_cost(update=args.update_budgets)
     for r in rollups.values():
