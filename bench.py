@@ -350,6 +350,7 @@ def main():
                 "counters": None, "wave_spread": None,
                 "tracer_mode": None, "fused_blocks_per_flush": None,
                 "phase_seconds": None,
+                "host_overlap_fraction": None,
                 "live_bytes_per_sec": None, "live_flops_per_sec": None,
                 "hbm_peak_bytes_per_sec": None,
                 "live_vs_static_ratio": None,
@@ -535,7 +536,7 @@ def main():
     # BENCH rows stay schema-comparable)
     import jax as _jax
 
-    from tpu_pbrt.obs.metrics import phase_summary
+    from tpu_pbrt.obs.metrics import host_overlap_fraction, phase_summary
     from tpu_pbrt.obs.rooflive import live_vs_static
 
     tstats = result.stats.get("telemetry") or {}
@@ -563,6 +564,14 @@ def main():
         # fused-vs-jnp phase evidence ROADMAP #1 stage two waits on
         # (null under TPU_PBRT_METRICS=0; rows stay schema-comparable)
         "phase_seconds": phase_summary(),
+        # device_wait / measured wall over the MEASURED leg (ISSUE 13):
+        # 1.0 = the host tax (deposit/develop/checkpoint bookkeeping)
+        # fully hidden under in-flight dispatch — the pipelined-drain
+        # acceptance number, strictly better at TPU_PBRT_PIPELINE=2
+        # than the depth-1 synchronous baseline
+        "host_overlap_fraction": host_overlap_fraction(
+            result.stats.get("phase_seconds"), result.seconds
+        ),
         **live_vs_static(
             waves=result.stats.get("n_waves"),
             seconds=result.seconds,

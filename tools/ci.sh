@@ -73,14 +73,25 @@ python -m tpu_pbrt.obs "$SMOKE_DIR/trace.json" \
 echo "== fused wavefront kernel smoke (python -m tpu_pbrt.chaos --only fused-tracer)"
 python -m tpu_pbrt.chaos --only fused-tracer
 
+# pipelined-dispatch smoke (ISSUE 13): a poisoning dispatch loss with
+# TPU_PBRT_PIPELINE=3 chunk-slices in flight must flush the window,
+# roll back to a deferred-written checkpoint and recover a film
+# bit-identical to the undisturbed render. Standalone first for a fast,
+# named failure; the full matrix below re-runs it under the explicit
+# default depth.
+echo "== pipelined dispatch smoke (python -m tpu_pbrt.chaos --only pipeline)"
+TPU_PBRT_PIPELINE=2 python -m tpu_pbrt.chaos --only pipeline
+
 # chaos recovery matrix (ISSUE 5): every fault scenario — poisoned/clean
 # dispatch loss, torn/crashed/bit-flipped checkpoint writes, corrupt
 # checkpoint resume, NaN wave, retry-budget exhaustion, mesh device
 # loss — must recover to a film BIT-identical to the undisturbed render
 # (the nan-wave-scrub row instead gates the degrade semantics: finite
 # image + nonfinite_deposits>0). Runs on CPU; no accelerator needed.
+# TPU_PBRT_PIPELINE=2 is the default, exported explicitly so the gate
+# keeps covering the pipelined drain even if the default ever moves
 echo "== chaos recovery matrix (python -m tpu_pbrt.chaos)"
-python -m tpu_pbrt.chaos
+TPU_PBRT_PIPELINE=2 python -m tpu_pbrt.chaos
 
 # render-service smoke (ISSUE 6 + ISSUE 10): submit two cropped cornell
 # jobs to one service, preempt/resume one mid-render, and require both
@@ -90,7 +101,7 @@ python -m tpu_pbrt.chaos
 # a lint-clean Prometheus metrics exposition with per-tenant histograms.
 echo "== render service smoke (python -m tpu_pbrt.serve --selftest)"
 XLA_FLAGS="${XLA_FLAGS:-} --xla_backend_optimization_level=0" \
-python -m tpu_pbrt.serve --selftest
+TPU_PBRT_PIPELINE=2 python -m tpu_pbrt.serve --selftest
 
 # metrics registry selftest + bench trajectory report (ISSUE 10
 # satellites): the registry's record -> exposition -> lint -> percentile

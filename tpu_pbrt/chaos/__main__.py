@@ -391,8 +391,29 @@ def scen_fused_tracer(tmp):
     return True, f"fused == jnp bit-identical; fired={fired}"
 
 
+def scen_pipeline(tmp):
+    """Async pipelined dispatch (ISSUE 13): a poisoning dispatch loss
+    with TPU_PBRT_PIPELINE=3 slices in flight — the window is flushed,
+    the loop rolls back to the last durable checkpoint (whose cadence
+    writes were DEFERRED under in-flight compute via the film
+    snapshot) and the recovered film is bit-identical to the
+    undisturbed render. Pins the tentpole's two contracts at once:
+    depth-N == depth-1 bits, and the recovery ladder carrying over
+    unchanged with a non-empty window."""
+    r, rep = _run(
+        plan="dispatch:poison@chunk=3",
+        ckpt=os.path.join(tmp, "film.ckpt"),
+        env={"TPU_PBRT_PIPELINE": "3"},
+    )
+    ok, detail = _check_recovered(r, rep, want_fired={"dispatch:poison": 1})
+    if ok and r.stats.get("recovery", {}).get("rollbacks") != 1:
+        return False, "expected exactly 1 checkpoint rollback"
+    return ok, detail
+
+
 SCENARIOS = {
     "fused-tracer": scen_fused_tracer,
+    "pipeline": scen_pipeline,
     "clean-redispatch": scen_clean_redispatch,
     "poison-rollback": scen_poison_rollback,
     "poison-restart": scen_poison_restart,

@@ -89,6 +89,8 @@ class Config:
         "deposit_seg",
         "serve_chunk",
         "serve_resident_mb",
+        "pipeline",
+        "serve_prefetch",
         "audit_drops",
         "allow_drops",
         "shard_native_check",
@@ -181,6 +183,26 @@ class Config:
         #: sort); >= pool or negative = full-width (the exact pre-segment
         #: program)
         self.deposit_seg: int = _int("TPU_PBRT_DEPOSIT_SEG", 0)
+        #: in-flight dispatch window (ISSUE 13): how many chunk-slices
+        #: the drain loops keep launched ahead of the host. JAX dispatch
+        #: is async, so depth N lets every piece of host-side work
+        #: (deposit bookkeeping, preview develop, checkpoint
+        #: serialization, scheduling, metrics/flight recording) run
+        #: UNDER the device compute of the slices still in flight; 1 is
+        #: the strictly synchronous dispatch/block/host-work loop (the
+        #: A/B baseline for host_overlap_fraction). Bit-identity is
+        #: depth-independent by construction — the window only moves
+        #: sync points, never the dispatched programs. The strict
+        #: non-finite firewall modes force depth 1 (their per-chunk
+        #: scrub-count sync cannot be pipelined away); see
+        #: parallel/mesh.resolve_pipeline_depth
+        self.pipeline: int = _int("TPU_PBRT_PIPELINE", 2)
+        #: render-service dispatch lookahead: while the current job's
+        #: slice is in flight, pre-activate the NEXT scheduled job
+        #: (plan build + checkpoint film load host->HBM + residency LRU
+        #: touch) so its first dispatch is not serialized behind its
+        #: activation. Never preempts, never changes the schedule
+        self.serve_prefetch: bool = _flag("TPU_PBRT_SERVE_PREFETCH", True)
         #: render-service slice width (camera rays per submit/step
         #: quantum — the preemption granularity; None = platform chunk)
         self.serve_chunk: Optional[int] = _int("TPU_PBRT_SERVE_CHUNK", None)

@@ -267,6 +267,111 @@ def redispatch_backoff(chunk: int, attempt: int) -> float:
     return b * (0.5 + 0.5 * frac)
 
 
+class DispatchWindow:
+    """Bounded in-flight window of dispatched chunk-slices (ISSUE 13 /
+    ROADMAP #2 — the refactor every other speed item inherits).
+
+    JAX dispatch is async: ``plan.dispatch`` returns device futures
+    immediately. This class gives that asynchrony structure: keep up to
+    ``depth`` slices launched ahead, and RETIRE the oldest (block on
+    its per-chunk sync handle) only when the window is full — so all
+    host-side work between dispatches (deposit bookkeeping, preview
+    develop, checkpoint serialization, WFQ scheduling, metrics/flight/
+    trace recording) runs UNDER the device compute of the slices still
+    in flight. ``depth`` 1 reproduces the strictly synchronous
+    dispatch/block/host-work loop — the A/B baseline the
+    ``host_overlap_fraction`` acceptance compares against. Bit-identity
+    across depths holds by construction: the window moves SYNC POINTS,
+    never the dispatched programs or their order.
+
+    Deferred actions (``defer``) run once their cursor's slice has
+    retired — the checkpoint path snapshots the film accumulator
+    device-side at enqueue time (``parallel/checkpoint.film_snapshot``;
+    the live accumulator is donated into the next dispatch) and
+    serializes the snapshot to disk under in-flight compute.
+
+    Error contract: a device failure surfacing at a retire sync is
+    re-raised as ``ChunkDispatchError(poisons_state=True)`` so the
+    caller's recovery ladder handles it like a mid-dispatch loss; on
+    ANY ChunkDispatchError the caller calls ``flush`` before the ladder
+    — poisoning failures discard the window outright (the rollback/
+    restart re-renders everything it covered), clean failures quiesce
+    it (block on the survivors, run the deferred durable writes) so
+    completed work is never lost to an unrelated chunk's retry streak.
+    """
+
+    __slots__ = ("depth", "slices", "deferred", "on_wait", "span_name")
+
+    def __init__(self, depth: int, on_wait=None, span_name: str = ""):
+        self.depth = max(1, int(depth))
+        #: [(chunk index, per-chunk device sync handle)]
+        self.slices: list = []
+        #: [(cursor, fn)] — fn() runs once chunk cursor-1 has retired
+        self.deferred: list = []
+        self.on_wait = on_wait  # dt -> None (device_wait attribution)
+        self.span_name = span_name
+
+    def __len__(self) -> int:
+        return len(self.slices)
+
+    def push(self, chunk: int, handle) -> None:
+        self.slices.append((chunk, handle))
+
+    def defer(self, cursor: int, fn) -> None:
+        self.deferred.append((cursor, fn))
+
+    def full(self) -> bool:
+        return len(self.slices) >= self.depth
+
+    def retire_one(self) -> int:
+        """Block on the OLDEST in-flight slice (the device_wait phase),
+        then run every deferred action whose cursor has retired.
+        Returns the retired chunk index."""
+        chunk, handle = self.slices.pop(0)
+        from tpu_pbrt.obs.trace import TRACE
+
+        t0 = time.perf_counter()
+        try:
+            if self.span_name:
+                with TRACE.span(self.span_name, chunk=chunk):
+                    jax.block_until_ready(handle)
+            else:
+                jax.block_until_ready(handle)
+        except jax.errors.JaxRuntimeError as e:
+            raise ChunkDispatchError(
+                f"in-flight slice {chunk} failed: {e}", poisons_state=True
+            ) from e
+        finally:
+            if self.on_wait is not None:
+                self.on_wait(time.perf_counter() - t0)
+        while self.deferred and self.deferred[0][0] <= chunk + 1:
+            self.deferred.pop(0)[1]()
+        return chunk
+
+    def drain(self) -> None:
+        """Retire everything in flight and run every deferred action."""
+        while self.slices:
+            self.retire_one()
+        while self.deferred:
+            self.deferred.pop(0)[1]()
+
+    def flush(self, discard: bool = False) -> None:
+        """Error-path teardown (see the class docstring). discard=True
+        drops handles and deferred actions without touching the device;
+        discard=False drains — and any latent async failure surfaces
+        HERE, inside the caller's ladder, as a poisoning
+        ChunkDispatchError with the window already cleared."""
+        if discard:
+            self.slices.clear()
+            self.deferred.clear()
+            return
+        try:
+            self.drain()
+        finally:
+            self.slices.clear()
+            self.deferred.clear()
+
+
 def _fixed_batch_nonfinite(p_film, L):
     """Non-finite-firewall count for the fixed-batch deposit paths: rows
     the film is about to scrub, restricted to valid work items (body()
@@ -333,11 +438,20 @@ class ChunkPlan:
     #: surfaced in RenderResult.stats / bench telemetry for roofline
     #: attribution, and part of the jit-closure cache identity
     tracer: str = "jnp"
+    #: in-flight window depth the closure compiled for (ISSUE 13):
+    #: depth 1 donates the film carry (the zero-copy in-place chain,
+    #: byte-for-byte the pre-pipeline program); depth > 1 compiles
+    #: WITHOUT donation so the carry pipelines as a true async enqueue
+    #: and the previous accumulator stays readable for deferred
+    #: checkpoint writes — see prepare_chunks for the full rationale
+    pipeline_depth: int = 1
 
     def dispatch(self, state, c: int):
-        """Dispatch chunk ``c`` against ``state`` (the film accumulator
-        is DONATED — callers must use the returned state and never touch
-        the argument again). Returns (state, aux)."""
+        """Dispatch chunk ``c`` against ``state``. At pipeline_depth 1
+        the film accumulator is DONATED — callers must use the returned
+        state and never touch the argument again; at depth > 1 the
+        closure compiled without donation and ``state`` stays readable
+        (the deferred-checkpoint contract). Returns (state, aux)."""
         st = self.starts[c]
         if self.mesh is None and self.chaos_nan:
             from tpu_pbrt.chaos import CHAOS
@@ -1004,9 +1118,29 @@ class WavefrontIntegrator:
         from tpu_pbrt.accel.stream import tracer_mode as _tracer_mode
 
         tracer = _tracer_mode(2 * (pool if use_regen else per_dev))
+        # in-flight window depth this plan compiles for (ISSUE 13).
+        # Depth 1 donates the film carry — in-place accumulation, the
+        # exact pre-pipeline program. Depth > 1 compiles WITHOUT
+        # donation: re-donating a chained carry (the previous
+        # dispatch's donation-aliased output) trips XLA:CPU's
+        # synchronous donation path and the whole chunk executes INLINE
+        # in the dispatch call (measured: dispatch ~58 ms..3.7 s,
+        # block_until_ready ~0 — the overlap the window exists to
+        # create silently erased), and an un-donated carry is also what
+        # lets a deferred checkpoint write hold the previous
+        # accumulator while newer slices are in flight. The price is
+        # one extra film allocation per in-flight slice;
+        # TPU_PBRT_PIPELINE=1 restores the zero-copy chain. Donation
+        # changes the compiled program, so it is part of the closure
+        # identity.
+        from tpu_pbrt.parallel.mesh import resolve_pipeline_depth
+
+        pipe_depth = resolve_pipeline_depth(mesh)
+        donate = (0,) if pipe_depth == 1 else ()
         jit_key = (
             scene, mesh, chunk, spp, total, n_dev, pool, use_regen,
             _obs_counters.enabled(), CHAOS.trace_key(), tracer,
+            bool(donate),
         )
         cached = getattr(self, "_jit_cache", None)
         if _LAST_TRACER and _LAST_TRACER[-1] != tracer:
@@ -1049,7 +1183,7 @@ class WavefrontIntegrator:
                         # unchanged
                         return fs2, (nrays, live, waves, trunc, ctr)
 
-                jfn = jax.jit(chunk_fn, donate_argnums=(0,))
+                jfn = jax.jit(chunk_fn, donate_argnums=donate)
             elif use_regen:
                 from tpu_pbrt.parallel.mesh import (
                     device_spread,
@@ -1082,7 +1216,7 @@ class WavefrontIntegrator:
 
                     return merge_film(state, contrib), aux
 
-                jfn = jax.jit(chunk_fn, donate_argnums=(0,))
+                jfn = jax.jit(chunk_fn, donate_argnums=donate)
             elif mesh is None:
                 # pixel-major chunks that tile the frame exactly take the
                 # film's scatter-free aligned accumulation path
@@ -1101,7 +1235,7 @@ class WavefrontIntegrator:
                         state = film.add_splats(state, *splats)
                     return state, (nrays if nf is None else (nrays, nf))
 
-                jfn = jax.jit(chunk_fn, donate_argnums=(0,))
+                jfn = jax.jit(chunk_fn, donate_argnums=donate)
             else:
                 from tpu_pbrt.parallel.mesh import sharded_chunk_renderer
 
@@ -1122,7 +1256,7 @@ class WavefrontIntegrator:
 
                     return merge_film(state, contrib), aux
 
-                jfn = jax.jit(chunk_fn, donate_argnums=(0,))
+                jfn = jax.jit(chunk_fn, donate_argnums=donate)
             self._jit_cache = (jit_key, jfn)
 
         # start cursors move host->device once per plan; the transfer is
@@ -1151,6 +1285,7 @@ class WavefrontIntegrator:
             spp=spp, total=total, npix=npix, bounds=(x0, x1, y0, y1),
             pool=pool, use_regen=use_regen, chaos_nan=chaos_nan,
             starts=starts, jfn=jfn, fingerprint=fp, tracer=tracer,
+            pipeline_depth=pipe_depth,
         )
 
     # -- the loop ---------------------------------------------------------
@@ -1249,29 +1384,36 @@ class WavefrontIntegrator:
             for k in ("chunks_redispatched", "retry_backoff_ms")
         }
 
-        def ctr_snapshot():
+        def ctr_snapshot(n_ctr=None, n_nf=None, rec=None):
             """Cumulative host counter dict (checkpoint payload / final
             stats): the saved snapshot + everything fetched so far. The
             device_get inside to_host is the telemetry's one explicit
             drain-boundary fetch (checkpoint writes are drain
             boundaries too). Folds in the fixed-batch firewall counts
-            and the host-side retry/backoff accounting."""
+            and the host-side retry/backoff accounting. n_ctr/n_nf/rec
+            restrict the snapshot to a LIST PREFIX + a recovery-dict
+            copy captured when a deferred (pipelined) checkpoint was
+            enqueued — the written counters cover exactly the chunks
+            the snapshot's cursor covers, not the slices dispatched
+            ahead of it."""
             snap = obs_counters.merge_host(
-                prev_ctr, obs_counters.to_host(ctr_counts)
+                prev_ctr, obs_counters.to_host(ctr_counts[:n_ctr])
             )
-            if nf_counts:
+            nf = nf_counts[:n_nf]
+            if nf:
                 snap = obs_counters.merge_host(
                     snap,
                     {
                         "nonfinite_deposits": sum(
-                            int(v) for v in jax.device_get(nf_counts)
+                            int(v) for v in jax.device_get(nf)
                         )
                     },
                 )
+            rec = recovery if rec is None else rec
             extra = {}
             for key, cur in (
-                ("chunks_redispatched", recovery["redispatches"]),
-                ("retry_backoff_ms", recovery["backoff_ms"]),
+                ("chunks_redispatched", rec["redispatches"]),
+                ("retry_backoff_ms", rec["backoff_ms"]),
             ):
                 # clamp: a rollback that fell back to a PRIOR process's
                 # .prev can hold smaller extras than the initial resume
@@ -1320,70 +1462,206 @@ class WavefrontIntegrator:
         c = first_chunk
         attempt = 0
         retry_t0 = None  # wall clock of the current failure streak
+        timed_out = False
+        # -- in-flight dispatch window (ISSUE 13): keep `depth` chunk-
+        # slices launched ahead and retire the oldest only when the
+        # window is full, so every piece of host-side work below —
+        # progress/heartbeats, deposit bookkeeping, deferred checkpoint
+        # serialization — runs under the device compute of the slices
+        # still in flight. Counters and device_get fetches still
+        # reconcile only at the existing drain boundaries. The depth
+        # comes from the PLAN (not re-resolved here): donation is
+        # compiled into the closure, and the loop's hold-the-carry
+        # checkpoint deferral is only legal against the depth the
+        # closure was built for.
+        from tpu_pbrt.parallel.checkpoint import begin_host_copy
+
+        depth = plan.pipeline_depth
+        window = DispatchWindow(
+            depth,
+            on_wait=lambda dt: _phase("device_wait", dt),
+            span_name="render/chunk_retire",
+        )
+
+        def _write_checkpoint(st, cursor, n_ray, n_ctr, n_nf, rec=None):
+            """One durable cadence write: chunks [0, cursor) of `st`,
+            counters restricted to the captured list prefixes."""
+            t_ph = time.perf_counter()
+            with TRACE.span("render/checkpoint", chunk=cursor):
+                save_checkpoint(
+                    ckpt_path, st, cursor,
+                    prev_rays + sum(
+                        int(r)
+                        for r in jax.device_get(ray_counts[:n_ray])
+                    ),
+                    fingerprint=fp,
+                    counters=ctr_snapshot(n_ctr, n_nf, rec),
+                )
+            _phase("checkpoint", time.perf_counter() - t_ph)
+
+        def _queue_checkpoint(cursor):
+            """Cadence checkpoint at `cursor`. With slices in flight the
+            durable write is deferred to the cursor's retirement — the
+            npz compression + CRC + fsync then run under the compute of
+            the newer slices. At depth > 1 the carry is never donated
+            (plan.pipeline_depth compiled donation out), so the
+            deferred write simply HOLDS the live accumulator reference
+            and starts its device->host copy early. With an empty
+            window (depth 1, or the first chunk) write immediately:
+            the exact pre-pipeline path."""
+            lens = (len(ray_counts), len(ctr_counts), len(nf_counts))
+            if not len(window):
+                _write_checkpoint(state, cursor, *lens)
+                return
+            snap = state
+            begin_host_copy(snap)
+            rec = dict(recovery)
+            window.defer(
+                cursor,
+                lambda: _write_checkpoint(snap, cursor, *lens, rec=rec),
+            )
+
         with STATS.phase("Integrator/Render loop"):
-            while c < n_chunks:
+            while c < n_chunks or len(window):
                 try:
-                    # failure seam (SURVEY.md §2e worker-failure row): a
-                    # dispatch that dies is re-run — chunks are idempotent
-                    # pure functions of the work range, so re-dispatch is
-                    # exact. If the failure could have poisoned the
-                    # accumulated film (a mid-flight device loss), the
-                    # checkpoint (if enabled) rolls the loop back to the
-                    # last durable state instead. The CHAOS registry
-                    # (tpu_pbrt/chaos) injects deterministic failures
-                    # here — the promoted form of the old test-only
-                    # `_fault_hook` monkeypatch.
-                    CHAOS.dispatch(c, attempt, mesh=mesh is not None)
-                    try:
-                        # the first dispatch blocks the host on jit
-                        # trace+compile; later ones are async enqueues —
-                        # the span names keep the two distinguishable in
-                        # the exported trace
-                        t_ph = time.perf_counter()
-                        with TRACE.span(
-                            "render/chunk_dispatch+compile"
-                            if c == first_chunk else "render/chunk_dispatch",
-                            chunk=c, tracer=plan.tracer,
-                        ):
-                            state, aux = plan.dispatch(state, c)
-                        _phase(
-                            "dispatch_compile" if c == first_chunk
-                            else "dispatch",
-                            time.perf_counter() - t_ph,
-                        )
-                    except jax.errors.JaxRuntimeError as e:
-                        # real device/runtime loss mid-dispatch: the donated
-                        # film accumulator can no longer be trusted — route
-                        # through the poisoning recovery (checkpoint
-                        # rollback or restart), never reuse `state`
-                        raise ChunkDispatchError(
-                            f"device dispatch failed: {e}", poisons_state=True
-                        ) from e
-                    if firewall_mode != "scrub":
-                        # strict firewall: check THIS chunk's scrub count
-                        # (costs one per-chunk device sync — opt-in).
-                        # raise-mode aborts; retry-mode treats the chunk
-                        # as poisoned (its deposits hold zeroed radiance)
-                        # and re-renders it exactly.
-                        nf_dev = chunk_nonfinite(aux)
-                        nf_ct = (
-                            0 if nf_dev is None
-                            else int(jax.device_get(nf_dev))
-                        )
-                        if nf_ct:
-                            if firewall_mode == "raise":
-                                raise NonFiniteRadianceError(
-                                    f"chunk {c} deposited {nf_ct} non-finite "
-                                    "radiance sample(s) (scrubbed to zero); "
-                                    "TPU_PBRT_NONFINITE=raise treats this "
-                                    "as fatal"
-                                )
-                            recovery["nonfinite_retries"] += 1
-                            raise NonFiniteWaveError(
-                                f"non-finite firewall: chunk {c} scrubbed "
-                                f"{nf_ct} deposit(s)"
+                    if c < n_chunks:
+                        # failure seam (SURVEY.md §2e worker-failure row):
+                        # a dispatch that dies is re-run — chunks are
+                        # idempotent pure functions of the work range, so
+                        # re-dispatch is exact. If the failure could have
+                        # poisoned the accumulated film (a mid-flight
+                        # device loss), the checkpoint (if enabled) rolls
+                        # the loop back to the last durable state instead.
+                        # The CHAOS registry (tpu_pbrt/chaos) injects
+                        # deterministic failures here — the promoted form
+                        # of the old test-only `_fault_hook` monkeypatch.
+                        CHAOS.dispatch(c, attempt, mesh=mesh is not None)
+                        try:
+                            # the first dispatch blocks the host on jit
+                            # trace+compile; later ones are async enqueues
+                            # — and one issued with older slices still in
+                            # flight has its host cost hidden under their
+                            # compute, so it is attributed separately
+                            # (dispatch_ahead)
+                            if c == first_chunk:
+                                ph_name = "dispatch_compile"
+                                span = "render/chunk_dispatch+compile"
+                            elif len(window):
+                                ph_name = "dispatch_ahead"
+                                span = "render/chunk_dispatch_ahead"
+                            else:
+                                ph_name = "dispatch"
+                                span = "render/chunk_dispatch"
+                            t_ph = time.perf_counter()
+                            with TRACE.span(
+                                span, chunk=c, tracer=plan.tracer,
+                            ):
+                                state, aux = plan.dispatch(state, c)
+                            _phase(ph_name, time.perf_counter() - t_ph)
+                        except jax.errors.JaxRuntimeError as e:
+                            # real device/runtime loss mid-dispatch: the
+                            # donated film accumulator can no longer be
+                            # trusted — route through the poisoning
+                            # recovery (checkpoint rollback or restart),
+                            # never reuse `state`
+                            raise ChunkDispatchError(
+                                f"device dispatch failed: {e}",
+                                poisons_state=True,
+                            ) from e
+                        if firewall_mode != "scrub":
+                            # strict firewall: check THIS chunk's scrub
+                            # count (costs one per-chunk device sync —
+                            # opt-in; resolve_pipeline_depth forces the
+                            # window to depth 1 in these modes, exactly
+                            # because of this sync). raise-mode aborts;
+                            # retry-mode treats the chunk as poisoned
+                            # (its deposits hold zeroed radiance) and
+                            # re-renders it exactly.
+                            nf_dev = chunk_nonfinite(aux)
+                            nf_ct = (
+                                0 if nf_dev is None
+                                else int(jax.device_get(nf_dev))
                             )
+                            if nf_ct:
+                                if firewall_mode == "raise":
+                                    raise NonFiniteRadianceError(
+                                        f"chunk {c} deposited {nf_ct} "
+                                        "non-finite radiance sample(s) "
+                                        "(scrubbed to zero); "
+                                        "TPU_PBRT_NONFINITE=raise treats "
+                                        "this as fatal"
+                                    )
+                                recovery["nonfinite_retries"] += 1
+                                raise NonFiniteWaveError(
+                                    f"non-finite firewall: chunk {c} "
+                                    f"scrubbed {nf_ct} deposit(s)"
+                                )
+                        attempt = 0
+                        retry_t0 = None
+                        c += 1
+                        if use_regen:
+                            nrays, lv, wv, trunc = aux[:4]
+                            occ_counts.append((lv, wv, trunc))
+                            if len(aux) > 4 and aux[4] is not None:
+                                ctr_counts.append(aux[4])
+                            if len(aux) > 5 and aux[5] is not None:
+                                spread_counts.append(aux[5])
+                        elif isinstance(aux, tuple):
+                            nrays, nf_dep = aux
+                            nf_counts.append(nf_dep)
+                        else:
+                            nrays = aux
+                        ray_counts.append(nrays)
+                        progress.update()
+                        chunks_done = c
+                        if c == first_chunk + 1 or c % hb_every == 0:
+                            FLIGHT.heartbeat(
+                                "render", chunk=c, of=n_chunks,
+                                render_s=round(time.time() - t0, 3),
+                            )
+                        if (
+                            ckpt_path and checkpoint_every
+                            and c % checkpoint_every == 0
+                        ):
+                            _queue_checkpoint(c)
+                        window.push(c - 1, nrays)
+                    # retire the oldest slice(s): only when the window is
+                    # full (the host work above ran under their compute),
+                    # plus the full drain once the work domain is
+                    # exhausted. Each retire blocks on ONE per-chunk sync
+                    # handle — the device keeps executing the newer
+                    # in-flight slices through the wait.
+                    while len(window) and (window.full() or c >= n_chunks):
+                        window.retire_one()
+                    if max_seconds > 0:
+                        # time-boxed mode: the retire above paces the wall
+                        # clock to completed work while the window keeps
+                        # the pipe full. When the measured chunk rate says
+                        # the remaining budget cannot absorb the in-flight
+                        # window, drain eagerly — bounding overshoot to
+                        # ~1 chunk duration even for very slow chunks.
+                        done_n = max(len(ray_counts) - len(window), 1)
+                        rate = (time.time() - t0) / done_n
+                        if (
+                            max_seconds - (time.time() - t0)
+                            < (depth + 2) * rate
+                        ):
+                            window.drain()
+                        if time.time() - t0 > max_seconds:
+                            timed_out = True
                 except ChunkDispatchError as e:
+                    # flush the in-flight window BEFORE the ladder: a
+                    # poisoning failure discards it outright (rollback/
+                    # restart re-renders everything it covered); a clean
+                    # failure quiesces it — blocking on the survivors
+                    # surfaces any latent async loss here, and the
+                    # deferred durable writes land before the retry
+                    # streak can burn the attempt budget
+                    try:
+                        window.flush(discard=e.poisons_state)
+                    except ChunkDispatchError as e2:
+                        e = e2  # the flush itself found a poisoned device
+                        window.flush(discard=True)
                     attempt += 1
                     recovery["redispatches"] += 1
                     STATS.counter("Distribution/Chunks re-dispatched", 1)
@@ -1455,65 +1733,8 @@ class WavefrontIntegrator:
                     if backoff_s > 0:
                         time.sleep(backoff_s)
                     continue
-                attempt = 0
-                retry_t0 = None
-                c += 1
-                if use_regen:
-                    nrays, lv, wv, trunc = aux[:4]
-                    occ_counts.append((lv, wv, trunc))
-                    if len(aux) > 4 and aux[4] is not None:
-                        ctr_counts.append(aux[4])
-                    if len(aux) > 5 and aux[5] is not None:
-                        spread_counts.append(aux[5])
-                elif isinstance(aux, tuple):
-                    nrays, nf_dep = aux
-                    nf_counts.append(nf_dep)
-                else:
-                    nrays = aux
-                ray_counts.append(nrays)  # defer the sync: keep the pipe full
-                progress.update()
-                chunks_done = c
-                if c == first_chunk + 1 or c % hb_every == 0:
-                    FLIGHT.heartbeat(
-                        "render", chunk=c, of=n_chunks,
-                        render_s=round(time.time() - t0, 3),
-                    )
-                if ckpt_path and checkpoint_every and c % checkpoint_every == 0:
-                    t_ph = time.perf_counter()
-                    with TRACE.span("render/checkpoint", chunk=c):
-                        save_checkpoint(
-                            ckpt_path,
-                            state,
-                            c,
-                            prev_rays
-                            + sum(int(r) for r in jax.device_get(ray_counts)),
-                            fingerprint=fp,
-                            counters=ctr_snapshot(),
-                        )
-                    _phase("checkpoint", time.perf_counter() - t_ph)
-                if max_seconds > 0:
-                    # time-boxed mode: block on a chunk a few dispatches
-                    # BACK, so the wall clock tracks completed work while
-                    # keeping the dispatch pipe full (a per-chunk sync on
-                    # `state` would serialize the loop and depress the
-                    # measured throughput). The first chunks sync eagerly,
-                    # and when the measured chunk rate says the pipeline
-                    # depth would blow the budget we fall back to eager
-                    # syncs — bounding overshoot to ~1 chunk duration even
-                    # for very slow chunks.
-                    lag = 4
-                    done_n = len(ray_counts)
-                    rate = (time.time() - t0) / max(done_n, 1)
-                    eager = done_n <= lag or (
-                        max_seconds - (time.time() - t0) < (lag + 2) * rate
-                    )
-                    t_ph = time.perf_counter()
-                    jax.block_until_ready(
-                        ray_counts[-1] if eager else ray_counts[-1 - lag]
-                    )
-                    _phase("device_wait", time.perf_counter() - t_ph)
-                    if time.time() - t0 > max_seconds:
-                        break
+                if timed_out:
+                    break
             # device execution of the queued wave batches (and, on a
             # mesh, the ICI film psum/merge) completes inside this sync
             t_ph = time.perf_counter()

@@ -400,11 +400,18 @@ PHASE_HISTOGRAM = "render_phase_seconds"
 SPAN_PHASES = {
     "render/chunk_dispatch": "dispatch",
     "render/chunk_dispatch+compile": "dispatch_compile",
+    # a dispatch issued while older slices are still in flight (the
+    # pipelined window, ISSUE 13): its host cost is hidden under device
+    # compute, so it is attributed separately from a bare dispatch
+    "render/chunk_dispatch_ahead": "dispatch_ahead",
+    "render/chunk_retire": "device_wait",
     "render/wave_drain+film_merge": "device_wait",
     "render/develop": "deposit_develop",
     "render/write_image": "deposit_develop",
     "render/checkpoint": "checkpoint",
     "serve/slice": "dispatch",
+    "serve/slice_ahead": "dispatch_ahead",
+    "serve/slice_retire": "device_wait",
 }
 
 
@@ -463,6 +470,38 @@ def phase_summary(
         if agg:
             out[ph] = agg
     return out or None
+
+
+def host_overlap_fraction(
+    phases: Optional[Dict[str, float]] = None,
+    wall_seconds: Optional[float] = None,
+    registry: MetricsRegistry = METRICS,
+) -> Optional[float]:
+    """device_wait seconds / wall — the fraction of the drain's wall
+    time the host spent blocked on device compute rather than doing its
+    own work serially (ISSUE 13 / ROADMAP #2's acceptance metric). 1.0
+    means every host-side second — deposit bookkeeping, develop,
+    checkpoint serialization, scheduling — was hidden under in-flight
+    dispatches; the gap to 1.0 is the host tax the pipeline window
+    exists to hide.
+
+    `phases` is a {phase: seconds} dict (a render's
+    stats["phase_seconds"]); None aggregates the process-wide phase
+    histogram instead. `wall_seconds` is the measured wall clock; None
+    falls back to the sum of the attributed phases (a lower bound on
+    wall, so the fallback fraction is an upper bound). Returns None
+    when nothing was attributed."""
+    if phases is None:
+        summ = phase_summary(registry)
+        if not summ:
+            return None
+        phases = {ph: agg["seconds"] for ph, agg in summ.items()}
+    if not phases:
+        return None
+    wall = wall_seconds if wall_seconds else sum(phases.values())
+    if not wall or wall <= 0:
+        return None
+    return round(min(float(phases.get("device_wait", 0.0)) / wall, 1.0), 4)
 
 
 # -- validation (tests + `python -m tpu_pbrt.obs` + CI) --------------------

@@ -105,6 +105,29 @@ def _rotate_prev(path: str) -> None:
         os.replace(path, prev)
 
 
+def begin_host_copy(state: FilmState) -> None:
+    """Start the device->host DMA for a film state EARLY, best-effort.
+
+    The pipelined drain loops (ISSUE 13) call this when they defer a
+    cadence checkpoint write: the write runs only once the slice it
+    covers has retired, so starting the copy at enqueue time means the
+    transfer streams out under device compute and `save_checkpoint`'s
+    np.asarray fetch becomes a wait on an already-moving DMA instead of
+    a fresh round trip. Safe only because a deferred write holds an
+    UN-DONATED accumulator (pipeline depth > 1 compiles donation out of
+    the chunk closure — see ChunkPlan.pipeline_depth); a donated buffer
+    must never be touched after dispatch. Advisory: arrays without the
+    async-copy API (or backends that refuse it) fall through to the
+    blocking fetch at write time."""
+    for leaf in (state.rgb, state.weight, state.splat):
+        fn = getattr(leaf, "copy_to_host_async", None)
+        if fn is not None:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a failed prefetch only
+                pass  # costs the blocking fetch the write always paid
+
+
 def checkpoint_exists(path: str) -> bool:
     """True when `path` OR its `.prev` rotation holds a resumable file.
     Resume/rollback sites must use this rather than a bare exists(path):

@@ -162,6 +162,30 @@ def resolve_mesh(mesh_shape) -> Optional[Mesh]:
     return mesh
 
 
+def resolve_pipeline_depth(mesh: Optional[Mesh] = None) -> int:
+    """Effective in-flight dispatch window for the drain loops (ISSUE
+    13): how many chunk-slices stay launched ahead of the host.
+    TPU_PBRT_PIPELINE (default 2), clamped to >= 1 — depth 1 is the
+    strictly synchronous dispatch/block/host-work loop, the A/B
+    baseline the host_overlap_fraction acceptance compares against.
+
+    The strict non-finite firewall modes (TPU_PBRT_NONFINITE=raise|
+    retry) force depth 1: they read each chunk's scrub count before the
+    NEXT dispatch may trust the accumulator — a per-chunk device sync
+    pipelining cannot hide, and eager checking keeps the failure
+    attributed to the exact chunk that scrubbed.
+
+    A mesh does not widen the window: every dispatch spans the whole
+    mesh (one SPMD program per chunk), so the in-flight slices are in
+    program order regardless of device count. `mesh` is accepted for
+    call-site symmetry and future per-topology tuning."""
+    from tpu_pbrt.config import cfg
+
+    if cfg.nonfinite != "scrub":
+        return 1
+    return max(1, int(cfg.pipeline))
+
+
 def device_spread(value, n_dev: int, axis: str = TILE_AXIS):
     """One-hot scatter of a per-device scalar into an (n_dev,) vector:
     device i contributes `value` at slot i, zeros elsewhere, so the

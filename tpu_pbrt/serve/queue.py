@@ -107,6 +107,17 @@ class FairScheduler:
                 best, best_key = j, k
         return best
 
+    def peek(self, jobs: Iterable):
+        """Read-only lookahead: which job WOULD dispatch next — the
+        service's prefetch path (ISSUE 13) uses this to pre-activate
+        the next scheduled job under in-flight compute. Identical
+        ordering to `pick` (neither charges vtime; accounting happens
+        separately via `charge`) — the distinct name documents the
+        prefetch contract that peeking must never perturb the recorded
+        schedule, and gives the policy room to diverge later (e.g. a
+        pick that reserves) without breaking lookahead callers."""
+        return self.pick(jobs)
+
     def charge(self, tenant: str, cost: float = 1.0) -> None:
         """Account one dispatched chunk-slice to `tenant`."""
         ts = self.tenant(tenant)

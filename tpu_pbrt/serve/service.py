@@ -54,6 +54,7 @@ from tpu_pbrt.core.film import FilmState
 from tpu_pbrt.integrators.common import (
     ChunkDispatchError,
     ChunkPlan,
+    DispatchWindow,
     NonFiniteRadianceError,
     NonFiniteWaveError,
     RenderResult,
@@ -65,7 +66,7 @@ from tpu_pbrt.parallel.checkpoint import (
     load_checkpoint,
     save_checkpoint,
 )
-from tpu_pbrt.obs.metrics import METRICS
+from tpu_pbrt.obs.metrics import METRICS, phase_histogram
 from tpu_pbrt.serve.queue import FairScheduler, SloPolicy, preemption_victim
 from tpu_pbrt.serve.residency import (
     ResidencyCache,
@@ -175,6 +176,10 @@ class RenderJob:
     #: wall-clock deadline before which this job must not re-dispatch
     #: (the capped-backoff window; other tenants schedule meanwhile)
     not_before: float = 0.0
+    #: in-flight dispatch window (ISSUE 13): per-slice sync handles +
+    #: deferred checkpoint writes, created lazily at the first dispatch
+    #: and torn down at every park/recover/cancel/finalize boundary
+    window: Optional[DispatchWindow] = None
     rollbacks: int = 0
     restarts: int = 0
     preemptions: int = 0
@@ -198,21 +203,25 @@ class RenderJob:
             int(r) for r in jax.device_get(self.ray_counts)
         )
 
-    def snapshot_counters(self) -> Dict[str, Any]:
+    def snapshot_counters(self, n_ctr=None, n_nf=None) -> Dict[str, Any]:
         """Cumulative telemetry counter dict — the checkpoint payload.
         The device_get inside to_host is this job's drain-boundary
-        fetch (park/finalize ARE drain boundaries)."""
+        fetch (park/finalize ARE drain boundaries). n_ctr/n_nf restrict
+        the fetch to a list prefix: a deferred (pipelined) cadence
+        checkpoint must persist counters for exactly the slices its
+        cursor covers, not the ones dispatched ahead of it."""
         from tpu_pbrt.obs import counters as obs_counters
 
         snap = obs_counters.merge_host(
-            self.prev_ctr, obs_counters.to_host(self.ctr_counts)
+            self.prev_ctr, obs_counters.to_host(self.ctr_counts[:n_ctr])
         )
-        if self.nf_counts:
+        nf = self.nf_counts[:n_nf]
+        if nf:
             snap = obs_counters.merge_host(
                 snap,
                 {
                     "nonfinite_deposits": sum(
-                        int(v) for v in jax.device_get(self.nf_counts)
+                        int(v) for v in jax.device_get(nf)
                     )
                 },
             )
@@ -480,10 +489,17 @@ class RenderService:
                 g.set(0, priority=prio)
 
     # -- the scheduler step -------------------------------------------------
-    def _runnable(self) -> List[RenderJob]:
+    def _runnable(self, now: Optional[float] = None) -> List[RenderJob]:
+        """Runnable jobs as of `now`. Callers that also reason about
+        backoff windows (step's min-not_before wait) MUST pass the same
+        `now` they use there: sampling the clock twice lets a job fall
+        between the samples — excluded from the runnable set yet also
+        past its not_before — and step() would return None with work
+        still pending (nondeterministic under test clocks)."""
         active = [j for j in self.jobs.values() if j.state is not None]
         out = []
-        now = time.time()
+        if now is None:
+            now = time.time()
         for j in self.jobs.values():
             if j.status not in _RUNNABLE:
                 continue
@@ -503,14 +519,19 @@ class RenderService:
         """Dispatch ONE chunk-slice of the policy-selected job. Returns
         that job's id, or None when nothing is schedulable (all jobs
         terminal, paused, or blocked on residency)."""
-        job = self.scheduler.pick(self._runnable())
+        # `now` is sampled ONCE per step: the runnable filter and the
+        # backoff-wait computation below must see the SAME clock, or a
+        # job whose not_before falls between two samples is excluded
+        # from both — step() would answer None with work still pending
+        now = time.time()
+        job = self.scheduler.pick(self._runnable(now))
         if job is None:
             # nothing dispatchable — but a job whose backoff window is
             # still open is WORK, not idleness: wait out the earliest
             # deadline so drain() doesn't return with jobs unfinished
             waiting = [
                 j.not_before for j in self.jobs.values()
-                if j.status in _RUNNABLE and j.not_before > time.time()
+                if j.status in _RUNNABLE and j.not_before > now
             ]
             if waiting:
                 time.sleep(max(min(waiting) - time.time(), 0.0))
@@ -520,6 +541,13 @@ class RenderService:
         try:
             self._activate(job)
             self._dispatch_slice(job)
+            if cfg.serve_prefetch:
+                # dispatch lookahead (ISSUE 13): the slice just launched
+                # is in flight — use its device time to pre-activate the
+                # NEXT scheduled job (plan build + checkpoint film load
+                # host->HBM + residency LRU touch) so the following
+                # step's dispatch is not serialized behind activation
+                self._prefetch_next(job)
         except Exception as e:  # noqa: BLE001
             # an unexpected error (trace failure, OOM, corrupt resume)
             # fails THE JOB, not the service — other tenants keep
@@ -529,10 +557,53 @@ class RenderService:
                 job.status = FAILED
                 job.error = job.error or f"{type(e).__name__}: {e}"
             job.state = None
+            job.window = None
             self.residency.unpin(job.resident_key)
             self._update_depth_gauge()
             self._flight(job, "serve_failed", error=str(job.error)[:200])
         return job.job_id
+
+    def _prefetch_next(self, current: RenderJob) -> None:
+        """Pre-activate the job the policy would schedule next, under
+        the device compute of `current`'s in-flight slice: build its
+        ChunkPlan (the residency lookup inside _activate also touches
+        the scene's LRU slot) and load its film state host->HBM from
+        its checkpoint. Pure overlap: it only runs when a film-state
+        slot is free (a prefetch must never preempt), and it never
+        perturbs the schedule — the peek is re-made, unchanged, by the
+        next step. Self-contained error handling: a broken prefetch
+        fails THAT job, never the one that just dispatched."""
+        cand = [
+            j for j in self._runnable()
+            if j is not current and j.state is None
+        ]
+        nxt = self.scheduler.peek(cand)
+        if nxt is None:
+            return
+        if self.max_active is not None:
+            active = [j for j in self.jobs.values() if j.state is not None]
+            if len(active) >= self.max_active:
+                return
+        from tpu_pbrt.obs.trace import TRACE
+
+        try:
+            with TRACE.span("serve/prefetch", job=nxt.job_id):
+                self._activate(nxt)
+            METRICS.counter(
+                "serve_prefetches_total",
+                "next-job activations overlapped under in-flight dispatch",
+            ).inc(tenant=nxt.tenant)
+            self._flight(nxt, "serve_prefetch", chunk=nxt.cursor)
+        except Exception as e:  # noqa: BLE001 — a broken prefetch fails
+            # the prefetched job exactly like its own step() would have
+            if nxt.status not in _TERMINAL:
+                nxt.status = FAILED
+                nxt.error = f"{type(e).__name__}: {e}"
+            nxt.state = None
+            nxt.window = None
+            self.residency.unpin(nxt.resident_key)
+            self._update_depth_gauge()
+            self._flight(nxt, "serve_failed", error=str(nxt.error)[:200])
 
     def drain(self, max_steps: int = 1_000_000) -> None:
         """Step until no job is schedulable (paused jobs stay parked)."""
@@ -585,6 +656,7 @@ class RenderService:
         job.status = CANCELLED
         job.state = None
         job.plan = None
+        job.window = None
         self.residency.unpin(job.resident_key)
         self.residency.evict_over_budget()
         if job.spool_ckpt:
@@ -721,6 +793,15 @@ class RenderService:
         preemption write — PR 5's durable path: CRC + fsync + .prev)."""
         from tpu_pbrt.obs.trace import TRACE
 
+        if job.window is not None:
+            # drop still-deferred cadence writes: the park write below
+            # supersedes them at the SAME path with a newer cursor, so
+            # draining them here would pay redundant npz+CRC+fsync per
+            # preemption. The in-flight slices need no explicit sync —
+            # save_checkpoint's host fetch of the newest state blocks
+            # on them (and surfaces any latent async failure)
+            job.window.flush(discard=True)
+            job.window = None
         with TRACE.span("serve/park", job=job.job_id, chunk=job.cursor):
             save_checkpoint(
                 job.checkpoint_path, job.state, job.cursor,
@@ -742,16 +823,78 @@ class RenderService:
         ).inc(tenant=job.tenant)
         self._flight(job, "serve_park", chunk=job.cursor)
 
+    def _queue_checkpoint(self, job: RenderJob) -> None:
+        """Cadence checkpoint for a job. With slices in flight the
+        durable write is deferred to the slice's retirement, so the npz
+        compression + CRC + fsync run under in-flight compute; the
+        carry is never donated at depth > 1 (plan.pipeline_depth
+        compiled donation out), so the deferred write holds the live
+        accumulator reference directly and starts its device->host
+        copy early. With an empty window, write immediately (the exact
+        pre-pipeline path)."""
+        from tpu_pbrt.parallel.checkpoint import begin_host_copy
+
+        plan = job.plan
+        cursor = job.cursor
+        if job.window is None or not len(job.window):
+            save_checkpoint(
+                job.checkpoint_path, job.state, cursor,
+                job.rays_so_far(), fingerprint=plan.fingerprint,
+                counters=job.snapshot_counters(),
+            )
+            return
+        snap = job.state
+        begin_host_copy(snap)
+        n_ray = len(job.ray_counts)
+        n_ctr = len(job.ctr_counts)
+        n_nf = len(job.nf_counts)
+
+        def write():
+            save_checkpoint(
+                job.checkpoint_path, snap, cursor,
+                job.prev_rays + sum(
+                    int(r)
+                    for r in jax.device_get(job.ray_counts[:n_ray])
+                ),
+                fingerprint=plan.fingerprint,
+                counters=job.snapshot_counters(n_ctr, n_nf),
+            )
+
+        job.window.defer(cursor, write)
+
     def _dispatch_slice(self, job: RenderJob) -> None:
         """One chunk-slice with the recovery ladder (capped-backoff
         re-dispatch; poisoning failures roll back to the job's last
-        checkpoint or restart the job)."""
+        checkpoint or restart the job). Pipelined (ISSUE 13): the
+        dispatch is an async enqueue into the job's in-flight window —
+        the bookkeeping below, the next step's scheduling decision and
+        the next-job prefetch all run under its device compute; the
+        window's oldest slice is retired (one bounded sync) only when
+        the window is full."""
         from tpu_pbrt.chaos import CHAOS
         from tpu_pbrt.obs.trace import TRACE
 
         plan = job.plan
         c = job.cursor
         t0 = time.time()
+        if job.window is None:
+            tracer = plan.tracer
+
+            def on_wait(dt, _tracer=tracer):
+                if METRICS.enabled:
+                    phase_histogram().observe(
+                        dt, phase="device_wait", tracer=_tracer
+                    )
+
+            # the depth comes from the PLAN: donation is compiled into
+            # the chunk closure, and holding job.state for deferred
+            # checkpoint writes is only legal at the depth it was
+            # built for
+            job.window = DispatchWindow(
+                plan.pipeline_depth,
+                on_wait=on_wait,
+                span_name="serve/slice_retire",
+            )
         if job.ready_t:
             # queue wait: became-dispatchable -> this dispatch (includes
             # scheduler contention and any backoff window — the latency
@@ -772,8 +915,12 @@ class RenderService:
         try:
             CHAOS.dispatch(c, job.attempt, mesh=self.mesh is not None)
             try:
+                # a slice launched with older ones still in flight has
+                # its host cost hidden under their compute — attributed
+                # separately (dispatch_ahead), like the render loop
                 with TRACE.span(
-                    "serve/slice", job=job.job_id, chunk=c,
+                    "serve/slice_ahead" if len(job.window) else "serve/slice",
+                    job=job.job_id, chunk=c,
                 ):
                     state, aux = plan.dispatch(job.state, c)
             except jax.errors.JaxRuntimeError as e:
@@ -782,6 +929,8 @@ class RenderService:
                     f"device dispatch failed: {e}", poisons_state=True
                 ) from e
             if cfg.nonfinite != "scrub":
+                # (resolve_pipeline_depth forces the window to depth 1
+                # in the strict modes — this is a per-chunk device sync)
                 nrays, occ, ctr, _, nf = plan.aux_parts(aux)
                 nf_dev = ctr.nonfinite if ctr is not None else nf
                 nf_ct = 0 if nf_dev is None else int(jax.device_get(nf_dev))
@@ -799,15 +948,17 @@ class RenderService:
                         f"{nf_ct} deposit(s)"
                     )
         except ChunkDispatchError as e:
+            try:
+                job.window.flush(discard=e.poisons_state)
+            except ChunkDispatchError as e2:
+                e = e2  # the flush itself found a poisoned device
+                job.window.flush(discard=True)
+                job.state = None
             self._recover(job, e)
             return
         job.attempt = 0
         job.state = state
         job.cursor = c + 1
-        now = time.time()
-        job.active_seconds += now - t0
-        _slice_hist().observe(now - t0, tenant=job.tenant)
-        job.ready_t = now
         self.schedule.append((job.job_id, c))
         self.scheduler.charge(job.tenant)
         nrays, occ, ctr, spread, nf = plan.aux_parts(aux)
@@ -819,11 +970,27 @@ class RenderService:
         if nf is not None:
             job.nf_counts.append(nf)
         if job.checkpoint_every and job.cursor % job.checkpoint_every == 0:
-            save_checkpoint(
-                job.checkpoint_path, job.state, job.cursor,
-                job.rays_so_far(), fingerprint=plan.fingerprint,
-                counters=job.snapshot_counters(),
-            )
+            self._queue_checkpoint(job)
+        # retire the oldest in-flight slice(s) only once the window is
+        # full — everything above (and the caller's prefetch + the next
+        # step's scheduling) ran under their device compute
+        job.window.push(c, nrays)
+        try:
+            while job.window.full():
+                job.window.retire_one()
+        except ChunkDispatchError as e:
+            job.state = None  # mid-flight device failure: untrusted
+            job.window.flush(discard=True)
+            self._recover(job, e)
+            return
+        # service time closes AFTER the retire: it must cover the
+        # bounded device sync (at depth 1 that is the whole chunk
+        # compute — the pre-pipeline meaning), not just the async
+        # enqueue + bookkeeping
+        now = time.time()
+        job.active_seconds += now - t0
+        _slice_hist().observe(now - t0, tenant=job.tenant)
+        job.ready_t = now
         if (
             job.preview_every
             and job.preview_path
@@ -835,6 +1002,7 @@ class RenderService:
             self._finalize(job)
 
     def _recover(self, job: RenderJob, e: ChunkDispatchError) -> None:
+        job.window = None  # flushed by the caller; rebuilt lazily
         job.attempt += 1
         job.redispatches += 1
         if job.attempt > int(cfg.retry_max):
@@ -909,6 +1077,10 @@ class RenderService:
         from tpu_pbrt.obs.trace import TRACE
 
         plan = job.plan
+        # still-deferred cadence writes are superseded by the terminal
+        # state below (spool checkpoints are deleted outright); the
+        # block on job.state is the job's full drain either way
+        job.window = None
         with TRACE.span("serve/finalize", job=job.job_id):
             jax.block_until_ready(job.state)
             rays = job.rays_so_far()
