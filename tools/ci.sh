@@ -1,7 +1,9 @@
 #!/usr/bin/env bash
-# Local CI gate (ISSUE 2 + ISSUE 3 + ISSUE 11 satellites):
+# Local CI gate (ISSUE 2 + ISSUE 3 + ISSUE 11 + ISSUE 15 satellites):
 #   ruff -> jaxlint (AST) -> jaxpr audit + jaxcost budget gate + shardcheck
-#   + pallascheck VMEM/grid-semantics gate -> tier-1 pytest.
+#   + pallascheck VMEM/grid-semantics gate -> telemetry/chaos/serve smokes
+#   -> tpu-scope (timeline reconstruction + health verb + bench gate)
+#   -> tier-1 pytest.
 #
 #   tools/ci.sh            # full gate
 #   tools/ci.sh --fast     # skip the pytest leg (lint + audit + gates only)
@@ -93,15 +95,46 @@ TPU_PBRT_PIPELINE=2 python -m tpu_pbrt.chaos --only pipeline
 echo "== chaos recovery matrix (python -m tpu_pbrt.chaos)"
 TPU_PBRT_PIPELINE=2 python -m tpu_pbrt.chaos
 
-# render-service smoke (ISSUE 6 + ISSUE 10): submit two cropped cornell
-# jobs to one service, preempt/resume one mid-render, and require both
-# films finite AND bit-identical to a solo run-to-completion render, a
-# warm resubmit with 0 scene compiles + 0 jit retraces, >= 1 streamed
-# preview, a DETERMINISTIC shed count from an over-SLO submit burst, and
-# a lint-clean Prometheus metrics exposition with per-tenant histograms.
-echo "== render service smoke (python -m tpu_pbrt.serve --selftest)"
+# render-service smoke (ISSUE 6 + ISSUE 10 + ISSUE 15): submit two
+# cropped cornell jobs to one service, preempt/resume one mid-render,
+# and require both films finite AND bit-identical to a solo
+# run-to-completion render, a warm resubmit with 0 scene compiles + 0
+# jit retraces, >= 1 streamed preview, a DETERMINISTIC shed count from
+# an over-SLO submit burst, a lint-clean Prometheus metrics exposition
+# with per-tenant histograms, trace-id exemplars on the slice
+# histogram, and a clean health-watchdog verdict. The run is
+# tracing-armed (TPU_PBRT_TRACE_PATH/FLIGHT_PATH) so the next stage can
+# reconstruct its job timelines.
+echo "== render service smoke, tracing-armed (python -m tpu_pbrt.serve --selftest)"
 XLA_FLAGS="${XLA_FLAGS:-} --xla_backend_optimization_level=0" \
+TPU_PBRT_TRACE_PATH="$SMOKE_DIR/serve_trace.json" \
+TPU_PBRT_FLIGHT_PATH="$SMOKE_DIR/serve_flight.jsonl" \
 TPU_PBRT_PIPELINE=2 python -m tpu_pbrt.serve --selftest
+
+# tpu-scope stage (ISSUE 15): (1) rebuild every job's causal timeline
+# from the selftest's trace + per-job flight exports and require it
+# complete — paired job/wait/slice async spans, bound dispatch->retire
+# flow arrows, ok-retired coverage of every chunk, flight heartbeats
+# joined by trace id; (2) round-trip the JSONL daemon's `health` verb
+# (the watchdog must report ok on an idle service — the chaos matrix
+# above already proved the wedge/backoff-storm rows DO flag it);
+# (3) the bench regression gate's selftest: baseline self-pass, infra
+# outage exemption, synthetic 50% regression caught by metric name.
+echo "== tpu-scope: timeline reconstruction + health verb + bench gate"
+python tools/scope.py "$SMOKE_DIR/serve_trace.json" \
+    --flight "$SMOKE_DIR/serve_flight.jsonl" --check
+printf '%s\n' '{"op": "health"}' '{"op": "shutdown"}' \
+    | python -m tpu_pbrt.serve > "$SMOKE_DIR/health.jsonl"
+python - "$SMOKE_DIR/health.jsonl" <<'EOF'
+import json, sys
+docs = [json.loads(x) for x in open(sys.argv[1]) if x.strip()]
+rep = next(d for d in docs if d.get("op") == "health")
+assert rep["ok"] and rep["firing"] == [], rep
+names = {c["name"] for c in rep["conditions"]}
+assert names == {"wedge", "backoff_storm", "slo_burn", "nonfinite_spike"}, names
+print(f"health verb OK ({len(names)} conditions, none firing)")
+EOF
+python tools/bench_gate.py --selftest
 
 # metrics registry selftest + bench trajectory report (ISSUE 10
 # satellites): the registry's record -> exposition -> lint -> percentile

@@ -1,0 +1,366 @@
+#!/usr/bin/env python
+"""tpu-scope: rebuild one render job's causal timeline from its trace +
+flight artifacts, and CHECK that the rebuild is complete (ISSUE 15).
+
+A depth-N pipelined serve run interleaves every job's dispatch enqueues,
+retire syncs, queue waits, previews and checkpoints on one host thread.
+The trace (obs/trace.py) records that interleaving as async spans keyed
+by deterministic ids — job root `t:<job>`, chunk-slice `t:<job>/c<n>`,
+queue-wait episode `t:<job>/q<k>` — and the per-job flight file stamps
+the same trace id on every heartbeat line. This tool is the consumer
+that proves those ids actually reconnect into a story:
+
+    python tools/scope.py trace.json                      # all jobs
+    python tools/scope.py trace.json --job j1             # one job
+    python tools/scope.py trace.json --flight flight.jsonl --check
+
+Per job it verifies (and `--check` exits non-zero, naming the job and
+the defect, when any fails):
+
+- the root `serve/job` async span is paired and carries a terminal
+  outcome (done / failed / cancelled);
+- every queue-wait episode is paired and episodes never overlap (a job
+  waits in at most one episode at a time, by construction);
+- every chunk-slice async span is paired, its `args.trace_id` matches
+  the id prefix (depth-N interleaving attributed to the right job), and
+  its dispatch->retire flow arrow is bound;
+- a DONE job's ok-retired slices cover chunks 0..chunks-1 gap-free —
+  recovery replays (rollback/restart re-dispatch the same chunk, park
+  re-bakes it) may retire a chunk more than once, but every chunk must
+  be ok-retired at least once somewhere on the timeline, and never
+  beyond the traced chunk count;
+- with `--flight`, the job's `flight.<job>.jsonl` parses, every line's
+  trace_id matches the job's, and the submit + terminal heartbeats for
+  the traced outcome are present.
+
+Everything here reads artifacts only — no jax, no device, safe in the
+leanest CI leg (the tools/ci.sh scope stage runs it against a
+tracing-armed serve selftest export).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+# runnable as a plain script from anywhere (tools/ is not a package)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_pbrt.obs.flight import job_flight_path  # noqa: E402
+from tpu_pbrt.obs.trace import validate_trace  # noqa: E402
+
+#: traced outcome -> the flight phase its terminal heartbeat uses
+_TERMINAL_PHASE = {
+    "done": "serve_done",
+    "failed": "serve_failed",
+    "cancelled": "serve_cancel",
+}
+
+
+class JobTimeline:
+    """Everything the trace recorded under one job's trace id."""
+
+    def __init__(self, trace_id: str):
+        self.trace_id = trace_id
+        self.job_id: str = ""
+        self.begin: Optional[Dict[str, Any]] = None
+        self.end: Optional[Dict[str, Any]] = None
+        #: id -> list of {"b": ev, "e": ev|None} slice span instances
+        self.slices: Dict[str, List[Dict[str, Any]]] = {}
+        #: id -> list of {"b": ev, "e": ev|None} queue-wait episodes
+        self.waits: Dict[str, List[Dict[str, Any]]] = {}
+        #: flow id -> starts - finishes
+        self.flows: Dict[str, int] = {}
+        #: X spans (dispatch, retire, preview, checkpoint, backoff...)
+        self.xspans: List[Dict[str, Any]] = []
+        #: instant events (preempt, sched/pick)
+        self.instants: List[Dict[str, Any]] = []
+        self.problems: List[str] = []
+
+    @property
+    def outcome(self) -> str:
+        return (self.end or {}).get("args", {}).get("outcome", "")
+
+    @property
+    def chunks(self) -> int:
+        return int((self.end or {}).get("args", {}).get("chunks", 0))
+
+    def _pairs(self, table, key, ev, is_begin):
+        insts = table.setdefault(key, [])
+        if is_begin:
+            insts.append({"b": ev, "e": None})
+        else:
+            open_ = [p for p in insts if p["e"] is None]
+            if not open_:
+                self.problems.append(
+                    f"async end for {key} without an open begin"
+                )
+            else:
+                open_[-1]["e"] = ev
+
+
+def _group(events: List[Dict[str, Any]]) -> Dict[str, JobTimeline]:
+    """Bucket every traced event under the job trace id it belongs to.
+    Attribution key: the async id's `t:<job>` prefix for slice/queue
+    spans, `args.trace_id` for X/instant spans."""
+    jobs: Dict[str, JobTimeline] = {}
+
+    def tl(tid: str) -> JobTimeline:
+        if tid not in jobs:
+            jobs[tid] = JobTimeline(tid)
+        return jobs[tid]
+
+    for ev in events:
+        ph, cat = ev.get("ph"), ev.get("cat", "")
+        args = ev.get("args") or {}
+        if ph in ("b", "e"):
+            eid = str(ev.get("id", ""))
+            if cat == "job":
+                t = tl(eid)
+                if ph == "b":
+                    if t.begin is not None:
+                        t.problems.append("duplicate serve/job begin")
+                    t.begin = ev
+                    t.job_id = args.get("job", "")
+                else:
+                    if t.end is not None:
+                        t.problems.append("duplicate serve/job end")
+                    t.end = ev
+            elif cat in ("slice", "queue"):
+                tid = eid.rsplit("/", 1)[0]
+                t = tl(tid)
+                table = t.slices if cat == "slice" else t.waits
+                t._pairs(table, eid, ev, ph == "b")
+                a_tid = args.get("trace_id")
+                if ph == "b" and a_tid and a_tid != tid:
+                    t.problems.append(
+                        f"span {eid} args.trace_id {a_tid!r} does not "
+                        f"match its id prefix (misattributed slice)"
+                    )
+        elif ph in ("s", "f"):
+            fid = str(ev.get("id", ""))
+            if "/c" in fid:
+                t = tl(fid.rsplit("/", 1)[0])
+                t.flows[fid] = t.flows.get(fid, 0) + (1 if ph == "s" else -1)
+        elif ph == "X" and args.get("trace_id") in jobs:
+            tl(args["trace_id"]).xspans.append(ev)
+        elif ph == "i" and args.get("trace_id") in jobs:
+            tl(args["trace_id"]).instants.append(ev)
+    return jobs
+
+
+def _check_job(t: JobTimeline) -> List[str]:
+    """The reconstruction invariants for one job. Returns defects."""
+    errs = list(t.problems)
+    if t.begin is None:
+        errs.append("no serve/job begin span")
+    if t.end is None:
+        errs.append("no serve/job end span (job never reached a terminal)")
+        return errs
+    if t.outcome not in ("done", "failed", "cancelled", "shed"):
+        errs.append(f"unknown terminal outcome {t.outcome!r}")
+
+    # queue-wait episodes: paired + non-overlapping
+    episodes = []
+    for eid, insts in sorted(t.waits.items()):
+        for p in insts:
+            if p["e"] is None:
+                errs.append(f"queue-wait {eid} never closed")
+            else:
+                episodes.append((p["b"]["ts"], p["e"]["ts"], eid))
+    episodes.sort()
+    for (_, a_end, a_id), (b_start, _, b_id) in zip(episodes, episodes[1:]):
+        if b_start < a_end:
+            errs.append(
+                f"queue-wait episodes {a_id} and {b_id} overlap "
+                "(a job waits in one episode at a time)"
+            )
+
+    # slices: paired, flow-bound, and (done) ok-retired gap-free
+    ok_chunks: Dict[int, int] = {}
+    for sid, insts in sorted(t.slices.items()):
+        try:
+            chunk = int(sid.rsplit("/c", 1)[1])
+        except (IndexError, ValueError):
+            errs.append(f"slice id {sid} has no /c<chunk> suffix")
+            continue
+        for p in insts:
+            if p["e"] is None:
+                errs.append(f"slice {sid} dispatched but never closed")
+            elif (p["e"].get("args") or {}).get("ok"):
+                ok_chunks[chunk] = ok_chunks.get(chunk, 0) + 1
+        if t.flows.get(sid, 0) != 0:
+            errs.append(
+                f"slice {sid} flow arrow unbalanced "
+                f"({t.flows[sid]:+d} start-finish)"
+            )
+    if t.outcome == "done":
+        want = set(range(t.chunks))
+        missing = sorted(want - set(ok_chunks))
+        if missing:
+            errs.append(
+                f"done with chunks={t.chunks} but no ok-retired slice "
+                f"span for chunk(s) {missing} (gap in the timeline)"
+            )
+        stray = sorted(set(ok_chunks) - want)
+        if stray:
+            errs.append(
+                f"ok-retired slice span(s) for chunk(s) {stray} beyond "
+                f"chunks={t.chunks}"
+            )
+    return errs
+
+
+def _check_flight(t: JobTimeline, flight_base: str) -> List[str]:
+    """Join the job's per-job flight file back onto its trace."""
+    if t.outcome == "shed" or not t.job_id:
+        return []  # sheds heartbeat on the MAIN file; nothing per-job
+    path = job_flight_path(flight_base, t.job_id)
+    errs: List[str] = []
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    except OSError as e:
+        return [f"per-job flight file unreadable: {e}"]
+    phases = set()
+    for i, raw in enumerate(lines):
+        try:
+            rec = json.loads(raw)
+        except ValueError as e:
+            errs.append(f"{path}:{i + 1}: not JSON: {e}")
+            continue
+        phases.add(rec.get("phase", ""))
+        lt = rec.get("trace_id")
+        if lt and lt != t.trace_id:
+            errs.append(
+                f"{path}:{i + 1}: trace_id {lt!r} is not the job's "
+                f"{t.trace_id!r} (flight/trace join broken)"
+            )
+    if "serve_submit" not in phases:
+        errs.append(f"{path}: no serve_submit heartbeat")
+    want = _TERMINAL_PHASE.get(t.outcome)
+    if want and want not in phases:
+        errs.append(
+            f"{path}: traced outcome {t.outcome!r} but no {want!r} "
+            f"heartbeat (saw: {sorted(phases)})"
+        )
+    return errs
+
+
+def _render(t: JobTimeline) -> str:
+    """Human-readable timeline: every reconstructed event, time-sorted."""
+    rows = []
+    if t.begin is not None:
+        rows.append((t.begin["ts"], f"submit  {t.trace_id}"))
+    for eid, insts in t.waits.items():
+        for p in insts:
+            dur = (p["e"]["ts"] - p["b"]["ts"]) / 1e3 if p["e"] else None
+            rows.append((
+                p["b"]["ts"],
+                f"wait    {eid}"
+                + (f"  {dur:.2f} ms" if dur is not None else "  (open!)"),
+            ))
+    for sid, insts in t.slices.items():
+        for p in insts:
+            if p["e"] is None:
+                rows.append((p["b"]["ts"], f"slice   {sid}  (never closed!)"))
+            else:
+                ok = (p["e"].get("args") or {}).get("ok")
+                dur = (p["e"]["ts"] - p["b"]["ts"]) / 1e3
+                rows.append((
+                    p["b"]["ts"],
+                    f"slice   {sid}  {dur:.2f} ms  "
+                    f"{'retired ok' if ok else 'aborted'}",
+                ))
+    for ev in t.xspans:
+        rows.append((
+            ev["ts"], f"span    {ev['name']}  {ev.get('dur', 0) / 1e3:.2f} ms"
+        ))
+    for ev in t.instants:
+        rows.append((ev["ts"], f"mark    {ev['name']}"))
+    if t.end is not None:
+        rows.append((
+            t.end["ts"],
+            f"end     outcome={t.outcome} chunks={t.chunks}",
+        ))
+    rows.sort(key=lambda r: r[0])
+    head = f"== {t.job_id or t.trace_id} ({t.trace_id}) =="
+    return "\n".join(
+        [head] + [f"  {ts / 1e3:10.2f} ms  {txt}" for ts, txt in rows]
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python tools/scope.py")
+    ap.add_argument("trace", help="Chrome-trace JSON exported by a serve run")
+    ap.add_argument(
+        "--flight", default="",
+        help="MAIN flight path the run used (per-job files are derived: "
+             "flight.jsonl -> flight.<job>.jsonl); enables the join check",
+    )
+    ap.add_argument(
+        "--job", default="", help="reconstruct only this job id"
+    )
+    ap.add_argument(
+        "--check", action="store_true",
+        help="verify every job's timeline is complete; exit non-zero "
+             "naming the first defective job",
+    )
+    args = ap.parse_args(argv)
+
+    errs = validate_trace(args.trace)
+    if errs:
+        for e in errs:
+            print(f"FAIL trace: {e}", file=sys.stderr)
+        return 1
+    with open(args.trace) as f:
+        events = json.load(f)["traceEvents"]
+    jobs = _group(events)
+    # groups with no serve/job root span are not requests: the
+    # monolithic render loop tags its slices "t:render" with no job
+    # lifecycle — its async pairing is already covered by the
+    # validator above, and there is no submit->terminal story to check
+    skipped = [
+        tid for tid, t in jobs.items()
+        if t.begin is None and t.end is None
+    ]
+    for tid in skipped:
+        del jobs[tid]
+    if skipped:
+        print(f"scope: skipped non-job span group(s): {sorted(skipped)}")
+    if args.job:
+        jobs = {
+            tid: t for tid, t in jobs.items()
+            if t.job_id == args.job or tid == f"t:{args.job}"
+        }
+        if not jobs:
+            print(f"FAIL no job {args.job!r} in the trace", file=sys.stderr)
+            return 1
+
+    defects = 0
+    for tid in sorted(jobs):
+        t = jobs[tid]
+        probs = _check_job(t)
+        if args.flight:
+            probs += _check_flight(t, args.flight)
+        if not args.check:
+            print(_render(t))
+        if probs:
+            defects += 1
+            for p in probs:
+                print(
+                    f"FAIL {t.job_id or tid}: {p}", file=sys.stderr
+                )
+    n_done = sum(1 for t in jobs.values() if t.outcome == "done")
+    print(
+        f"scope: {len(jobs)} job(s), {n_done} done, "
+        f"{defects} with defects"
+    )
+    return 1 if defects else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,11 @@ validates the DEGRADE semantics instead: the firewall zeroes the
 contaminated deposits, the final image stays fully finite, and
 `nonfinite_deposits > 0` is reported in telemetry.
 
+The matrix is also the health watchdog's truth table (ISSUE 15): the
+`serve-wedge` and `serve-backoff-storm` rows inject serve drains the
+watchdog MUST flag, and every other (clean) row asserts it stays
+silent — a false-positive gate run after each pass.
+
 This is the SURVEY §2e fault-tolerance claim turned into a gate: it runs
 in tools/ci.sh after the telemetry smoke stage, with no accelerator
 required.
@@ -411,6 +416,80 @@ def scen_pipeline(tmp):
     return ok, detail
 
 
+def _serve_retry_storm(steps, env):
+    """Shared rig for the watchdog rows: a serve job whose chunk-0
+    dispatch fails EVERY attempt (times=99) with zero retry backoff and
+    an unreachable retry budget — `steps` scheduler steps of pure
+    no-progress retrying, then the health verdict. Returns (service,
+    HealthReport) evaluated INSIDE the env overrides."""
+    from tpu_pbrt.chaos import CHAOS
+    from tpu_pbrt.obs.health import evaluate
+    from tpu_pbrt.obs.metrics import METRICS
+
+    overrides = {
+        "TPU_PBRT_CHUNK": CHUNK,
+        "TPU_PBRT_RETRY_BACKOFF": "0",
+        "TPU_PBRT_RETRY_MAX": "999",
+    }
+    overrides.update(env or {})
+    with _env(**overrides):
+        from tpu_pbrt.serve.service import RenderService
+
+        METRICS.reset()
+        scene, integ = _fresh()
+        service = RenderService(quiet=True)
+        service.submit(compiled=(scene, integ), tenant="chaos")
+        CHAOS.install("dispatch:fail@chunk=0&times=99", seed=0)
+        try:
+            for _ in range(steps):
+                service.step()
+            rep = evaluate(service)
+        finally:
+            CHAOS.clear()
+            METRICS.reset()
+    return service, rep
+
+
+def scen_serve_wedge(tmp):
+    """Health-watchdog row (ISSUE 15): a serve drain that retries the
+    same chunk forever — runnable work, K+ step() calls, no cursor
+    advance — MUST flag `wedge` (the failure mode that previously only
+    surfaced as a client timeout)."""
+    from tpu_pbrt.obs.health import Thresholds
+
+    k = Thresholds().resolved_wedge_steps()
+    service, rep = _serve_retry_storm(steps=k + 2, env=None)
+    if service.last_progress_step != 0:
+        return False, "rig broke: the wedged job made progress"
+    if "wedge" not in rep.firing():
+        return False, f"wedge NOT flagged after {k + 2} stuck steps: {rep.to_dict()}"
+    return True, f"flagged {rep.firing()} after {k + 2} stuck steps"
+
+
+def scen_serve_backoff_storm(tmp):
+    """Health-watchdog row: the SAME retry streak caught EARLY — enough
+    steps for the job's live attempt counter to cross the storm
+    threshold, but well inside the wedge window. `backoff_storm` must
+    flag; `wedge` must NOT (the two conditions separate a hot retry
+    loop from a dead drain)."""
+    from tpu_pbrt.obs.health import Thresholds
+
+    th = Thresholds()
+    steps = th.storm_attempts + 1
+    if steps >= th.resolved_wedge_steps():
+        return False, "rig broke: storm window not inside wedge window"
+    service, rep = _serve_retry_storm(steps=steps, env=None)
+    job = next(iter(service.jobs.values()))
+    if job.attempt < th.storm_attempts:
+        return False, f"rig broke: attempt {job.attempt} under threshold"
+    if "backoff_storm" not in rep.firing():
+        return False, f"backoff_storm NOT flagged: {rep.to_dict()}"
+    if "wedge" in rep.firing():
+        return False, f"wedge flagged {steps} steps in (threshold "  \
+            f"{th.resolved_wedge_steps()}): {rep.to_dict()}"
+    return True, f"flagged {rep.firing()} at attempt {job.attempt}"
+
+
 SCENARIOS = {
     "fused-tracer": scen_fused_tracer,
     "pipeline": scen_pipeline,
@@ -425,7 +504,14 @@ SCENARIOS = {
     "exhaustion-emergency-resume": scen_exhaustion_emergency_resume,
     "corrupt-resume": scen_corrupt_resume,
     "mesh-device-loss": scen_mesh_device_loss,
+    "serve-wedge": scen_serve_wedge,
+    "serve-backoff-storm": scen_serve_backoff_storm,
 }
+
+#: rows whose whole POINT is to trip the watchdog — every other row
+#: must leave the registry-derived health conditions clean (the
+#: watchdog's false-positive gate over the recovery matrix)
+_WATCHDOG_ROWS = {"serve-wedge", "serve-backoff-storm"}
 
 
 def main(argv=None) -> int:
@@ -475,6 +561,17 @@ def main(argv=None) -> int:
                 ok, detail = fn(sdir)
             except Exception as e:  # noqa: BLE001 — a broken scenario is a FAIL
                 ok, detail = False, f"{type(e).__name__}: {e}"
+            if ok and name not in _WATCHDOG_ROWS:
+                # false-positive gate: a CLEAN recovery row must not
+                # trip the registry-derived health conditions
+                from tpu_pbrt.obs.health import evaluate
+
+                hrep = evaluate(None)
+                if not hrep.ok:
+                    ok, detail = False, (
+                        f"health watchdog fired on a clean row: "
+                        f"{hrep.firing()}"
+                    )
             dt = time.time() - t0
             print(
                 f"chaos {name}: {'PASS' if ok else 'FAIL'} "

@@ -100,6 +100,8 @@ class Config:
         "trace_path",
         "flight_path",
         "flight_max_mb",
+        "metrics_exemplars",
+        "health_wedge_steps",
         "serve_slo_depth",
         "serve_slo_wait_s",
         "faults",
@@ -256,6 +258,16 @@ class Config:
         self.flight_max_mb: Optional[float] = _float(
             "TPU_PBRT_FLIGHT_MAX_MB", None
         )
+        #: exemplars retained per histogram series (tpu-scope): the
+        #: top-K observations by value, each carrying the trace/span ids
+        #: the caller attached — the join key from a slow percentile to
+        #: the exact trace span that produced it. 0 disables retention
+        self.metrics_exemplars: int = _int("TPU_PBRT_METRICS_EXEMPLARS", 4)
+        #: health watchdog wedge threshold: the service is flagged
+        #: wedged when runnable jobs exist but no chunk-slice has been
+        #: dispatched OR retired across this many consecutive step()
+        #: calls (obs/health.py)
+        self.health_wedge_steps: int = _int("TPU_PBRT_HEALTH_WEDGE_STEPS", 12)
         #: serve SLO admission control (ISSUE 10 / ROADMAP #2 load
         #: shedding): per-priority-class queue-DEPTH targets — a submit
         #: that would push the class's runnable-job count past its target

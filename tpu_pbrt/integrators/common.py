@@ -304,7 +304,7 @@ class DispatchWindow:
 
     def __init__(self, depth: int, on_wait=None, span_name: str = ""):
         self.depth = max(1, int(depth))
-        #: [(chunk index, per-chunk device sync handle)]
+        #: [(chunk index, per-chunk device sync handle, trace span|None)]
         self.slices: list = []
         #: [(cursor, fn)] — fn() runs once chunk cursor-1 has retired
         self.deferred: list = []
@@ -314,8 +314,39 @@ class DispatchWindow:
     def __len__(self) -> int:
         return len(self.slices)
 
-    def push(self, chunk: int, handle) -> None:
-        self.slices.append((chunk, handle))
+    def push(self, chunk: int, handle, span=None) -> None:
+        """`span` (tpu-scope): the async-span descriptor the caller
+        opened at dispatch enqueue — {"name", "id", "cat", optional
+        "flow"/"flow_name", "trace_id", "span_id"} — which the window
+        closes at the slice's retire sync (or its discard), so the
+        in-flight lifetime renders as one causally-bound track however
+        deep the pipeline runs."""
+        self.slices.append((chunk, handle, span))
+
+    @staticmethod
+    def _close_span(span, ok: bool) -> None:
+        if not span:
+            return
+        from tpu_pbrt.obs.trace import TRACE
+
+        fid = span.get("flow")
+        if fid:
+            TRACE.flow_finish(
+                span.get("flow_name", "slice_flow"), id=fid, ok=ok
+            )
+        TRACE.async_end(
+            span["name"], id=span["id"], cat=span.get("cat", "slice"), ok=ok
+        )
+
+    def close_spans(self, ok: bool) -> None:
+        """Close every in-flight slice's span WITHOUT retiring — for
+        callers that sync the whole job another way (the serve park/
+        finalize paths block on the film state, which transitively
+        blocks on every in-flight slice) and then drop the window. The
+        handles stay; later flush/drain sees the spans already closed."""
+        for i, (chunk, handle, span) in enumerate(self.slices):
+            self._close_span(span, ok)
+            self.slices[i] = (chunk, handle, None)
 
     def defer(self, cursor: int, fn) -> None:
         self.deferred.append((cursor, fn))
@@ -327,16 +358,23 @@ class DispatchWindow:
         """Block on the OLDEST in-flight slice (the device_wait phase),
         then run every deferred action whose cursor has retired.
         Returns the retired chunk index."""
-        chunk, handle = self.slices.pop(0)
+        chunk, handle, span = self.slices.pop(0)
         from tpu_pbrt.obs.trace import TRACE
 
+        targs = {
+            k: span[k]
+            for k in ("trace_id", "span_id")
+            if span and k in span
+        }
         t0 = time.perf_counter()
+        ok = False
         try:
             if self.span_name:
-                with TRACE.span(self.span_name, chunk=chunk):
+                with TRACE.span(self.span_name, chunk=chunk, **targs):
                     jax.block_until_ready(handle)
             else:
                 jax.block_until_ready(handle)
+            ok = True
         except jax.errors.JaxRuntimeError as e:
             raise ChunkDispatchError(
                 f"in-flight slice {chunk} failed: {e}", poisons_state=True
@@ -344,6 +382,7 @@ class DispatchWindow:
         finally:
             if self.on_wait is not None:
                 self.on_wait(time.perf_counter() - t0)
+            self._close_span(span, ok)
         while self.deferred and self.deferred[0][0] <= chunk + 1:
             self.deferred.pop(0)[1]()
         return chunk
@@ -362,12 +401,19 @@ class DispatchWindow:
         HERE, inside the caller's ladder, as a poisoning
         ChunkDispatchError with the window already cleared."""
         if discard:
+            # close (not leak) the in-flight spans: the validator's
+            # pairing invariant holds on error paths too, and the
+            # timeline records WHICH slices the rollback threw away
+            for _, _, span in self.slices:
+                self._close_span(span, ok=False)
             self.slices.clear()
             self.deferred.clear()
             return
         try:
             self.drain()
         finally:
+            for _, _, span in self.slices:
+                self._close_span(span, ok=False)
             self.slices.clear()
             self.deferred.clear()
 
@@ -1482,6 +1528,12 @@ class WavefrontIntegrator:
             on_wait=lambda dt: _phase("device_wait", dt),
             span_name="render/chunk_retire",
         )
+        # tpu-scope: one trace context for the whole render request —
+        # every in-flight chunk-slice becomes an async span under it,
+        # causally bound dispatch->retire by a flow event, so a depth-N
+        # trace renders as N overlapping tracks instead of flat X spans
+        # that pretend the loop is serial
+        rloop_tid = TRACE.trace_id("render")
 
         def _write_checkpoint(st, cursor, n_ray, n_ctr, n_nf, rec=None):
             """One durable cadence write: chunks [0, cursor) of `st`,
@@ -1624,7 +1676,17 @@ class WavefrontIntegrator:
                             and c % checkpoint_every == 0
                         ):
                             _queue_checkpoint(c)
-                        window.push(c - 1, nrays)
+                        sid = f"{rloop_tid}/c{c - 1}"
+                        TRACE.async_begin(
+                            "render/slice", id=sid, cat="slice",
+                            chunk=c - 1, trace_id=rloop_tid, span_id=sid,
+                        )
+                        TRACE.flow_start("slice_flow", id=sid)
+                        window.push(c - 1, nrays, span={
+                            "name": "render/slice", "id": sid,
+                            "cat": "slice", "flow": sid,
+                            "trace_id": rloop_tid, "span_id": sid,
+                        })
                     # retire the oldest slice(s): only when the window is
                     # full (the host work above ran under their compute),
                     # plus the full drain once the work domain is
@@ -1731,6 +1793,14 @@ class WavefrontIntegrator:
                         error=str(e)[:200],
                     )
                     if backoff_s > 0:
+                        # the backoff window's extent is known the
+                        # moment it opens — record it as an explicit-
+                        # duration span so the trace shows WHY the
+                        # timeline has a hole
+                        TRACE.complete(
+                            "render/backoff", backoff_s * 1e6, chunk=c,
+                            attempt=attempt, trace_id=rloop_tid,
+                        )
                         time.sleep(backoff_s)
                     continue
                 if timed_out:

@@ -21,6 +21,11 @@ Extras:
   --metrics-selftest  exercise the registry end to end (record -> lint
                    exposition -> percentile math) with no render; the
                    tools/ci.sh metrics stage.
+  --health         evaluate the tpu-scope health watchdog (obs/health.py)
+                   over a --metrics-snapshot file (the registry-derived
+                   conditions: slo_burn, nonfinite_spike; wedge/storm
+                   need a live service — use the daemon's `health` verb)
+                   and exit non-zero if any condition fires.
 """
 
 from __future__ import annotations
@@ -115,11 +120,18 @@ def main(argv=None) -> int:
         "--metrics-selftest", action="store_true",
         help="run the registry selftest (record/lint/percentiles) and exit",
     )
+    ap.add_argument(
+        "--health", action="store_true",
+        help="evaluate the health watchdog over --metrics-snapshot "
+             "(registry-derived conditions) and exit non-zero if firing",
+    )
     args = ap.parse_args(argv)
     if args.metrics_selftest:
         return metrics_selftest()
     if args.fold_metrics and not args.trace:
         ap.error("--fold-metrics needs a trace file to fold")
+    if args.health and not args.metrics_snapshot:
+        ap.error("--health needs --metrics-snapshot to evaluate")
     if not any((args.trace, args.flight, args.metrics,
                 args.metrics_snapshot)):
         ap.error(
@@ -173,6 +185,16 @@ def main(argv=None) -> int:
         problems += [f"metrics-snapshot: {e}" for e in errs]
         if not errs:
             print(f"metrics snapshot OK: {args.metrics_snapshot}")
+        if not errs and args.health:
+            from tpu_pbrt.obs.health import evaluate_snapshot
+
+            rep = evaluate_snapshot(args.metrics_snapshot)
+            print(json.dumps(rep.to_dict(), indent=2))
+            if not rep.ok:
+                problems += [
+                    f"health: condition firing: {name}"
+                    for name in rep.firing()
+                ]
 
     for p in problems:
         print(f"FAIL {p}", file=sys.stderr)

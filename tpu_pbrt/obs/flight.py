@@ -72,12 +72,11 @@ class FlightRecorder:
             # the heartbeat's own open() will surface/swallow that
             pass
 
-    def heartbeat(self, phase: str, **fields):
-        """One JSONL line: wall clock, elapsed seconds, phase, fields.
-        Opened/flushed/closed per line — crash-safe by construction."""
-        self.last_phase = phase
-        if not self.enabled:
-            return
+    def _write(self, path: str, phase: str, fields: Dict[str, Any]):
+        """One JSONL line to `path`: wall clock, elapsed seconds, phase,
+        fields. Opened/flushed/closed per line — crash-safe by
+        construction — behind the same rotation cap whichever file it
+        lands in."""
         line = {
             "t": round(time.time(), 3),
             "elapsed_s": round(time.time() - self._t0, 3),
@@ -89,7 +88,6 @@ class FlightRecorder:
             if k not in line:
                 line[k] = v
         try:
-            path = self.path
             self._maybe_rotate(path)
             with open(path, "a") as f:
                 f.write(json.dumps(line) + "\n")
@@ -97,6 +95,28 @@ class FlightRecorder:
             # a full/readonly disk must never kill the render it's
             # supposed to be diagnosing
             pass
+
+    def heartbeat(self, phase: str, **fields):
+        """One JSONL line on the main flight file."""
+        self.last_phase = phase
+        if not self.enabled:
+            return
+        self._write(self.path, phase, fields)
+
+    def job_heartbeat(self, job_id: str, phase: str, **fields):
+        """One JSONL line on the per-job flight file
+        (`flight.<job>.jsonl` next to the main path). First-class seam:
+        the render service used to re-arm `_path` around every per-job
+        heartbeat, which made the `TPU_PBRT_FLIGHT_MAX_MB` cap apply
+        only as a side effect of the swap (and left any other per-job
+        writer uncapped). Per-job files sit behind the same
+        single-rotation cap as the main one, by construction."""
+        self.last_phase = phase
+        if not self.enabled:
+            return
+        path = job_flight_path(self.path, job_id)
+        if path:
+            self._write(path, phase, fields)
 
     def counters(self, snapshot: Dict[str, Any], phase: str = "counters"):
         """Record the latest device-counter snapshot (the drain-boundary

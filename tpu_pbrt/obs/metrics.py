@@ -167,8 +167,13 @@ class Histogram(_Metric):
         if math.isinf(edges[-1]):
             edges = edges[:-1]  # the +Inf bucket is implicit
         self.edges = edges
+        # per-series exemplars: label key -> [(value, seq, fields), ...]
+        self._exemplars: Dict[Tuple[Tuple[str, str], ...], list] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(
+        self, value: float, exemplar: Optional[Dict[str, Any]] = None,
+        **labels,
+    ) -> None:
         if not self._enabled():
             return
         key = _label_key(labels)
@@ -184,7 +189,43 @@ class Histogram(_Metric):
                 break
         ser[0][i] += 1
         ser[1] += v
+        if exemplar is not None:
+            # seq = pre-increment observation count: a deterministic
+            # tiebreak that needs no extra state
+            self._note_exemplar(key, v, ser[2], exemplar)
         ser[2] += 1
+
+    def _note_exemplar(self, key, v: float, seq: int, fields):
+        """Bounded, deterministic exemplar retention (tpu-scope): keep
+        the top-K observations by value — the tail a debugger wants to
+        join back to a trace — with the join ids (trace_id/span_id) the
+        caller attached. Replacement is strictly-greater-than-the-min
+        with ties keeping the EARLIEST observation, so the retained set
+        is a pure function of the observation sequence (no reservoir
+        sampling, no clock), matching the registry's determinism
+        contract."""
+        from tpu_pbrt.config import cfg
+
+        k = cfg.metrics_exemplars
+        if k <= 0:
+            return
+        ex = self._exemplars.setdefault(key, [])
+        entry = (v, seq, dict(fields))
+        if len(ex) < k:
+            ex.append(entry)
+            return
+        mi = min(range(len(ex)), key=lambda i: (ex[i][0], -ex[i][1]))
+        if v > ex[mi][0]:
+            ex[mi] = entry
+
+    def exemplars(self, **labels) -> List[Dict[str, Any]]:
+        """Retained exemplars for one series, largest value first
+        (deterministic order: value desc, then observation seq)."""
+        ex = self._exemplars.get(_label_key(labels), [])
+        return [
+            {"value": v, **fields}
+            for v, _, fields in sorted(ex, key=lambda e: (-e[0], e[1]))
+        ]
 
     def _matching(self, match: Optional[Dict[str, Any]]):
         want = {str(k): str(v) for k, v in (match or {}).items()}
@@ -313,6 +354,9 @@ class MetricsRegistry:
                         entry[label] = percentile_from_buckets(
                             m.edges, ser[0], q
                         )
+                    ex = m.exemplars(**dict(key))
+                    if ex:
+                        entry["exemplars"] = ex
                 else:
                     entry["value"] = ser
                 series.append(entry)
@@ -565,6 +609,19 @@ def validate_snapshot(doc: Any) -> List[str]:
                     "count"
                 ):
                     errs.append(f"{sw}: count != sum of bucket counts")
+                ex = ser.get("exemplars")
+                if ex is not None:
+                    if not isinstance(ex, list):
+                        errs.append(f"{sw}: exemplars is not an array")
+                    else:
+                        for j, e in enumerate(ex):
+                            if not isinstance(e, dict) or not isinstance(
+                                e.get("value"), (int, float)
+                            ):
+                                errs.append(
+                                    f"{sw}.exemplars[{j}]: missing "
+                                    "numeric value"
+                                )
             elif not isinstance(ser.get("value"), (int, float)):
                 errs.append(f"{sw}: missing numeric value")
     return errs

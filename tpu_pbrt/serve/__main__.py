@@ -18,6 +18,7 @@ Ops:
   {"op": "result",  "job": "j1", "out": "final.exr?"}
   {"op": "stats"}
   {"op": "metrics", "out": "metrics.prom?"}   # Prometheus text exposition
+  {"op": "health"}                    # watchdog verdict (obs/health.py)
   {"op": "shutdown", "drain": true}
 
 A submit rejected by SLO admission control (TPU_PBRT_SERVE_SLO_DEPTH /
@@ -229,6 +230,13 @@ def _handle(service, req, out):
                 "ok": True, "op": op, "exposition": text,
                 "lines": len(text.splitlines()), "out": written,
             })
+        elif op == "health":
+            # the watchdog verdict (obs/health.py): deterministic over
+            # the service's own state + the metrics registry — what a
+            # monitor polls instead of waiting for client timeouts
+            from tpu_pbrt.obs.health import evaluate
+
+            _emit(out, {"ok": True, "op": op, **evaluate(service).to_dict()})
         elif op == "shutdown":
             return "drain" if req.get("drain", True) else "now"
         else:
@@ -477,6 +485,39 @@ def selftest(args) -> int:
         ):
             if needle not in exp:
                 fails.append(f"exposition missing {needle}")
+        # tpu-scope exemplars: the slice histogram's retained tail must
+        # carry trace ids — the join key back into the trace timeline
+        from tpu_pbrt.config import cfg as _cfg
+
+        if _cfg.metrics_exemplars > 0:
+            ser = (
+                METRICS.snapshot()["metrics"]
+                .get("tpu_pbrt_serve_slice_seconds", {})
+                .get("series", [])
+            )
+            if not any(
+                e.get("trace_id")
+                for s in ser for e in s.get("exemplars", [])
+            ):
+                fails.append("slice histogram has no trace-id exemplars")
+
+    # tpu-scope health: a clean selftest must not trip the watchdog
+    from tpu_pbrt.obs.health import evaluate
+
+    rep = evaluate(service)
+    if not rep.ok:
+        fails.append(
+            f"health watchdog fired on a clean selftest: {rep.firing()}"
+        )
+
+    # when tracing is armed (TPU_PBRT_TRACE_PATH / --trace), export the
+    # trace so CI's scope stage can reconstruct the job timelines from
+    # this very run
+    from tpu_pbrt.obs.trace import TRACE
+
+    traced = TRACE.maybe_export()
+    if traced:
+        say(f"trace exported to {traced}")
 
     line = {
         "selftest": "tpu_pbrt.serve",

@@ -97,14 +97,26 @@ class FairScheduler:
         ts = self.tenant(job.tenant)
         return (-job.priority, ts.vtime, self._tiebreak(job.tenant), job.seq)
 
-    def pick(self, jobs: Iterable):
-        """The runnable job to dispatch next, or None."""
+    def pick(self, jobs: Iterable, record: bool = True):
+        """The runnable job to dispatch next, or None. `record` marks
+        the decision on the trace timeline (an instant event carrying
+        the chosen job's trace id) — peek passes False, keeping the
+        lookahead contract that it leaves no mark anywhere."""
         best = None
         best_key = None
         for j in jobs:
             k = self.sort_key(j)
             if best is None or k < best_key:
                 best, best_key = j, k
+        if best is not None and record:
+            from tpu_pbrt.obs.trace import TRACE
+
+            TRACE.instant(
+                "sched/pick",
+                job=getattr(best, "job_id", ""),
+                tenant=best.tenant, priority=best.priority,
+                trace_id=getattr(best, "trace_id", ""),
+            )
         return best
 
     def peek(self, jobs: Iterable):
@@ -114,15 +126,21 @@ class FairScheduler:
         ordering to `pick` (neither charges vtime; accounting happens
         separately via `charge`) — the distinct name documents the
         prefetch contract that peeking must never perturb the recorded
-        schedule, and gives the policy room to diverge later (e.g. a
-        pick that reserves) without breaking lookahead callers."""
-        return self.pick(jobs)
+        schedule (or the trace: record=False), and gives the policy
+        room to diverge later (e.g. a pick that reserves) without
+        breaking lookahead callers."""
+        return self.pick(jobs, record=False)
 
     def charge(self, tenant: str, cost: float = 1.0) -> None:
         """Account one dispatched chunk-slice to `tenant`."""
         ts = self.tenant(tenant)
         ts.vtime += cost / ts.weight
         ts.slices += 1
+        from tpu_pbrt.obs.trace import TRACE
+
+        # a counter track per tenant: Perfetto plots the fair-share
+        # vtime race the schedule decisions above are explained by
+        TRACE.counter("sched/vtime", **{tenant: round(ts.vtime, 6)})
 
     def stats(self) -> Dict[str, Dict[str, float]]:
         return {

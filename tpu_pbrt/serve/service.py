@@ -191,6 +191,20 @@ class RenderJob:
     active_seconds: float = 0.0
     error: str = ""
     result: Optional[RenderResult] = None
+    # -- tpu-scope trace context (minted at submit) ------------------------
+    #: deterministic request trace id ("t:<job_id>") every span, flight
+    #: line, and histogram exemplar this job produces carries
+    trace_id: str = ""
+    #: queue-wait episodes opened so far (the per-episode async-span id
+    #: suffix: "<trace_id>/q<epoch>")
+    wait_epoch: int = 0
+    #: a queue-wait async span is currently open
+    wait_open: bool = False
+    #: the job's root async span has been closed (terminal outcome)
+    trace_done: bool = False
+    #: nonfinite deposits already reported to the registry counter (the
+    #: drain-boundary delta guard, like baked_redispatches)
+    nf_reported: int = 0
 
     # -- derived -----------------------------------------------------------
     def progress(self) -> float:
@@ -306,6 +320,11 @@ class RenderService:
         #: the dispatch record [(job_id, chunk_index), ...] — the
         #: deterministic-interleaving evidence tests assert on
         self.schedule: List[tuple] = []
+        # health-watchdog inputs (obs/health.py): step() calls made, and
+        # the step at which a chunk cursor last advanced — their gap is
+        # the wedge signal (runnable work, no progress)
+        self.health_steps = 0
+        self.last_progress_step = 0
 
     # -- submit ------------------------------------------------------------
     def submit(
@@ -424,6 +443,15 @@ class RenderService:
         )
         job.ready_t = time.time()
         self.jobs[job_id] = job
+        # tpu-scope: the job's trace context. The root async span opens
+        # here and closes at the terminal outcome; every span the job
+        # produces in between carries trace_id in its args
+        job.trace_id = TRACE.trace_id(job_id)
+        TRACE.async_begin(
+            "serve/job", id=job.trace_id, cat="job", job=job_id,
+            tenant=tenant, priority=job.priority, trace_id=job.trace_id,
+        )
+        self._trace_ready(job)
         METRICS.counter(
             "serve_submits_total", "jobs admitted by submit"
         ).inc(tenant=tenant)
@@ -459,9 +487,21 @@ class RenderService:
             "submits answered with a shed by SLO admission control",
         ).inc(tenant=tenant, priority=priority)
         from tpu_pbrt.obs.flight import FLIGHT
+        from tpu_pbrt.obs.trace import TRACE
 
+        # a shed request never gets a job id, but its refusal is part of
+        # the service timeline: a zero-length pseudo-trace records who
+        # was turned away and why
+        shed_tid = TRACE.trace_id(f"shed{self.sheds}")
+        TRACE.async_begin(
+            "serve/job", id=shed_tid, cat="job", outcome="shed",
+            tenant=tenant, priority=priority, reason=reason,
+            trace_id=shed_tid,
+        )
+        TRACE.async_end("serve/job", id=shed_tid, cat="job", outcome="shed")
         FLIGHT.heartbeat(
             "serve_shed", tenant=tenant, priority=priority, reason=reason,
+            trace_id=shed_tid,
         )
         raise ShedError(
             f"submit shed: {reason}", tenant=tenant, priority=priority,
@@ -523,6 +563,7 @@ class RenderService:
         # backoff-wait computation below must see the SAME clock, or a
         # job whose not_before falls between two samples is excluded
         # from both — step() would answer None with work still pending
+        self.health_steps += 1
         now = time.time()
         job = self.scheduler.pick(self._runnable(now))
         if job is None:
@@ -557,9 +598,12 @@ class RenderService:
                 job.status = FAILED
                 job.error = job.error or f"{type(e).__name__}: {e}"
             job.state = None
-            job.window = None
+            if job.window is not None:
+                job.window.flush(discard=True)  # closes in-flight spans
+                job.window = None
             self.residency.unpin(job.resident_key)
             self._update_depth_gauge()
+            self._trace_job_end(job, "failed")
             self._flight(job, "serve_failed", error=str(job.error)[:200])
         return job.job_id
 
@@ -587,7 +631,9 @@ class RenderService:
         from tpu_pbrt.obs.trace import TRACE
 
         try:
-            with TRACE.span("serve/prefetch", job=nxt.job_id):
+            with TRACE.span(
+                "serve/prefetch", job=nxt.job_id, trace_id=nxt.trace_id,
+            ):
                 self._activate(nxt)
             METRICS.counter(
                 "serve_prefetches_total",
@@ -600,9 +646,12 @@ class RenderService:
                 nxt.status = FAILED
                 nxt.error = f"{type(e).__name__}: {e}"
             nxt.state = None
-            nxt.window = None
+            if nxt.window is not None:
+                nxt.window.flush(discard=True)
+                nxt.window = None
             self.residency.unpin(nxt.resident_key)
             self._update_depth_gauge()
+            self._trace_job_end(nxt, "failed")
             self._flight(nxt, "serve_failed", error=str(nxt.error)[:200])
 
     def drain(self, max_steps: int = 1_000_000) -> None:
@@ -625,12 +674,21 @@ class RenderService:
         and PARK it until resume(). A job between slices loses nothing
         — the checkpoint is the exact (state, cursor, rays, counters)
         the next activation reloads."""
+        from tpu_pbrt.obs.trace import TRACE
+
         job = self._job(job_id)
         if job.status in _TERMINAL:
             raise ValueError(f"job {job_id} is {job.status}")
         if job.state is not None:
             self._park(job)
         job.status = PAUSED
+        # a paused job is not waiting for the scheduler: close the open
+        # queue-wait episode (resume opens a fresh one)
+        self._trace_wait_end(job)
+        TRACE.instant(
+            "serve/preempt", job=job.job_id, chunk=job.cursor,
+            trace_id=job.trace_id,
+        )
         self._update_depth_gauge()  # PAUSED is not runnable
         self._flight(job, "serve_preempt", chunk=job.cursor)
 
@@ -640,6 +698,7 @@ class RenderService:
             raise ValueError(f"job {job_id} is {job.status}, not paused")
         job.status = PARKED if job.cursor else QUEUED
         job.ready_t = time.time()
+        self._trace_ready(job)
         METRICS.counter(
             "serve_resumes_total", "paused jobs resumed"
         ).inc(tenant=job.tenant)
@@ -656,12 +715,15 @@ class RenderService:
         job.status = CANCELLED
         job.state = None
         job.plan = None
-        job.window = None
+        if job.window is not None:
+            job.window.flush(discard=True)  # closes in-flight spans
+            job.window = None
         self.residency.unpin(job.resident_key)
         self.residency.evict_over_budget()
         if job.spool_ckpt:
             delete_checkpoint(job.checkpoint_path)
         self._update_depth_gauge()
+        self._trace_job_end(job, "cancelled")
         self._flight(job, "serve_cancel", chunk=job.cursor)
 
     def poll(self, job_id: str) -> Dict[str, Any]:
@@ -727,21 +789,79 @@ class RenderService:
         return job
 
     def _flight(self, job: RenderJob, phase: str, **fields) -> None:
-        """Heartbeat into a PER-JOB flight file: the recorder is re-armed
-        with a job-keyed path around each write so concurrent jobs never
-        interleave into one stream (the BENCH_flight.jsonl collision)."""
-        from tpu_pbrt.obs.flight import FLIGHT, job_flight_path
+        """Heartbeat into the job's PER-JOB flight file (the recorder's
+        first-class `job_heartbeat` seam — concurrent jobs never
+        interleave into one stream, and the per-job file sits behind the
+        same TPU_PBRT_FLIGHT_MAX_MB rotation cap as the main one). Every
+        line carries the job's trace id: the join key from a flight
+        post-mortem back into the trace timeline."""
+        from tpu_pbrt.obs.flight import FLIGHT
 
-        base = FLIGHT.path
-        if not base:
-            FLIGHT.heartbeat(phase, job=job.job_id, **fields)
+        FLIGHT.job_heartbeat(
+            job.job_id, phase, job=job.job_id, trace_id=job.trace_id,
+            **fields,
+        )
+
+    # -- tpu-scope span threading -------------------------------------------
+    def _trace_ready(self, job: RenderJob) -> None:
+        """Open a queue-wait async span: the job just became
+        dispatchable (submit, slice completion, resume, recovery) and
+        waits for the scheduler to pick it again. One span per episode,
+        id "<trace_id>/q<epoch>" — closed by the next dispatch."""
+        from tpu_pbrt.obs.trace import TRACE
+
+        if job.trace_done or job.wait_open or not job.trace_id:
             return
-        orig = FLIGHT._path
-        try:
-            FLIGHT.configure(job_flight_path(base, job.job_id))
-            FLIGHT.heartbeat(phase, job=job.job_id, **fields)
-        finally:
-            FLIGHT.configure(orig)
+        job.wait_epoch += 1
+        job.wait_open = True
+        TRACE.async_begin(
+            "serve/queue_wait", id=f"{job.trace_id}/q{job.wait_epoch}",
+            cat="queue", job=job.job_id, chunk=job.cursor,
+            trace_id=job.trace_id,
+        )
+
+    def _trace_wait_end(self, job: RenderJob, wait=None) -> None:
+        from tpu_pbrt.obs.trace import TRACE
+
+        if not job.wait_open:
+            return
+        job.wait_open = False
+        kw = {} if wait is None else {"wait_s": round(wait, 6)}
+        TRACE.async_end(
+            "serve/queue_wait", id=f"{job.trace_id}/q{job.wait_epoch}",
+            cat="queue", **kw,
+        )
+
+    def _trace_job_end(self, job: RenderJob, outcome: str) -> None:
+        """Close the job's root async span with its terminal outcome
+        (done/failed/cancelled) — idempotent, and closes any queue-wait
+        episode still open so the trace's pairing invariant holds on
+        every terminal path."""
+        from tpu_pbrt.obs.trace import TRACE
+
+        if job.trace_done or not job.trace_id:
+            return
+        job.trace_done = True
+        self._trace_wait_end(job)
+        TRACE.async_end(
+            "serve/job", id=job.trace_id, cat="job", outcome=outcome,
+            chunks=job.cursor,
+        )
+
+    def _report_nonfinite(self, job: RenderJob, snap: Dict[str, Any]) -> None:
+        """Fold the job's firewall scrub count into the registry at its
+        drain boundaries (park/finalize — the places the device count is
+        already fetched), as a DELTA so repeated parks never
+        double-count. The watchdog's nonfinite-spike condition reads
+        this counter."""
+        total = int(snap.get("nonfinite_deposits", 0) or 0)
+        delta = total - job.nf_reported
+        if delta > 0:
+            METRICS.counter(
+                "render_nonfinite_total",
+                "non-finite radiance deposits scrubbed by the firewall",
+            ).inc(delta, tenant=job.tenant)
+            job.nf_reported = total
 
     def _activate(self, job: RenderJob) -> None:
         """Make the job dispatchable: build (or re-use) its ChunkPlan,
@@ -799,10 +919,16 @@ class RenderService:
             # draining them here would pay redundant npz+CRC+fsync per
             # preemption. The in-flight slices need no explicit sync —
             # save_checkpoint's host fetch of the newest state blocks
-            # on them (and surfaces any latent async failure)
+            # on them (and surfaces any latent async failure). Their
+            # deposits ARE in the saved cursor's coverage, so their
+            # spans close ok (the causal timeline has no gap here)
+            job.window.close_spans(ok=True)
             job.window.flush(discard=True)
             job.window = None
-        with TRACE.span("serve/park", job=job.job_id, chunk=job.cursor):
+        with TRACE.span(
+            "serve/park", job=job.job_id, chunk=job.cursor,
+            trace_id=job.trace_id,
+        ):
             save_checkpoint(
                 job.checkpoint_path, job.state, job.cursor,
                 job.rays_so_far(), fingerprint=job.plan.fingerprint,
@@ -811,6 +937,7 @@ class RenderService:
         job.prev_rays = job.rays_so_far()
         job.prev_ctr = job.snapshot_counters()
         job.baked_redispatches = job.redispatches
+        self._report_nonfinite(job, job.prev_ctr)
         job.ray_counts.clear()
         job.occ_counts.clear()
         job.ctr_counts.clear()
@@ -832,16 +959,21 @@ class RenderService:
         accumulator reference directly and starts its device->host
         copy early. With an empty window, write immediately (the exact
         pre-pipeline path)."""
+        from tpu_pbrt.obs.trace import TRACE
         from tpu_pbrt.parallel.checkpoint import begin_host_copy
 
         plan = job.plan
         cursor = job.cursor
         if job.window is None or not len(job.window):
-            save_checkpoint(
-                job.checkpoint_path, job.state, cursor,
-                job.rays_so_far(), fingerprint=plan.fingerprint,
-                counters=job.snapshot_counters(),
-            )
+            with TRACE.span(
+                "serve/checkpoint_write", job=job.job_id, chunk=cursor,
+                trace_id=job.trace_id, deferred=False,
+            ):
+                save_checkpoint(
+                    job.checkpoint_path, job.state, cursor,
+                    job.rays_so_far(), fingerprint=plan.fingerprint,
+                    counters=job.snapshot_counters(),
+                )
             return
         snap = job.state
         begin_host_copy(snap)
@@ -850,15 +982,22 @@ class RenderService:
         n_nf = len(job.nf_counts)
 
         def write():
-            save_checkpoint(
-                job.checkpoint_path, snap, cursor,
-                job.prev_rays + sum(
-                    int(r)
-                    for r in jax.device_get(job.ray_counts[:n_ray])
-                ),
-                fingerprint=plan.fingerprint,
-                counters=job.snapshot_counters(n_ctr, n_nf),
-            )
+            # the deferred durable write runs at its cursor's retirement
+            # — under newer slices' compute — but belongs to THIS job's
+            # trace, which the span args record
+            with TRACE.span(
+                "serve/checkpoint_write", job=job.job_id, chunk=cursor,
+                trace_id=job.trace_id, deferred=True,
+            ):
+                save_checkpoint(
+                    job.checkpoint_path, snap, cursor,
+                    job.prev_rays + sum(
+                        int(r)
+                        for r in jax.device_get(job.ray_counts[:n_ray])
+                    ),
+                    fingerprint=plan.fingerprint,
+                    counters=job.snapshot_counters(n_ctr, n_nf),
+                )
 
         job.window.defer(cursor, write)
 
@@ -895,14 +1034,21 @@ class RenderService:
                 on_wait=on_wait,
                 span_name="serve/slice_retire",
             )
+        sid = f"{job.trace_id}/c{c}"
         if job.ready_t:
             # queue wait: became-dispatchable -> this dispatch (includes
             # scheduler contention and any backoff window — the latency
             # the tenant actually observes, which is what the SLO wait
             # target bounds)
             wait = t0 - job.ready_t
+            self._trace_wait_end(job, wait)
             _queue_wait_hist().observe(
                 wait, tenant=job.tenant, priority=job.priority,
+                exemplar={
+                    "trace_id": job.trace_id,
+                    "span_id": f"{job.trace_id}/q{job.wait_epoch}",
+                    "job": job.job_id, "chunk": c,
+                },
             )
             win = self._recent_waits.get(job.priority)
             if win is None:
@@ -920,7 +1066,8 @@ class RenderService:
                 # separately (dispatch_ahead), like the render loop
                 with TRACE.span(
                     "serve/slice_ahead" if len(job.window) else "serve/slice",
-                    job=job.job_id, chunk=c,
+                    job=job.job_id, chunk=c, trace_id=job.trace_id,
+                    span_id=sid,
                 ):
                     state, aux = plan.dispatch(job.state, c)
             except jax.errors.JaxRuntimeError as e:
@@ -959,6 +1106,7 @@ class RenderService:
         job.attempt = 0
         job.state = state
         job.cursor = c + 1
+        self.last_progress_step = self.health_steps
         self.schedule.append((job.job_id, c))
         self.scheduler.charge(job.tenant)
         nrays, occ, ctr, spread, nf = plan.aux_parts(aux)
@@ -973,8 +1121,19 @@ class RenderService:
             self._queue_checkpoint(job)
         # retire the oldest in-flight slice(s) only once the window is
         # full — everything above (and the caller's prefetch + the next
-        # step's scheduling) ran under their device compute
-        job.window.push(c, nrays)
+        # step's scheduling) ran under their device compute. The slice's
+        # in-flight lifetime (enqueue -> retire sync) is an async span
+        # under the job's trace, causally bound by a flow event, so a
+        # depth-N window renders as N overlapping attributed tracks
+        TRACE.async_begin(
+            "serve/slice_inflight", id=sid, cat="slice", job=job.job_id,
+            chunk=c, trace_id=job.trace_id, span_id=sid,
+        )
+        TRACE.flow_start("slice_flow", id=sid)
+        job.window.push(c, nrays, span={
+            "name": "serve/slice_inflight", "id": sid, "cat": "slice",
+            "flow": sid, "trace_id": job.trace_id, "span_id": sid,
+        })
         try:
             while job.window.full():
                 job.window.retire_one()
@@ -989,8 +1148,16 @@ class RenderService:
         # enqueue + bookkeeping
         now = time.time()
         job.active_seconds += now - t0
-        _slice_hist().observe(now - t0, tenant=job.tenant)
+        _slice_hist().observe(
+            now - t0, tenant=job.tenant,
+            exemplar={
+                "trace_id": job.trace_id, "span_id": sid,
+                "job": job.job_id, "chunk": c,
+            },
+        )
         job.ready_t = now
+        if job.cursor < plan.n_chunks:
+            self._trace_ready(job)
         if (
             job.preview_every
             and job.preview_path
@@ -1013,6 +1180,7 @@ class RenderService:
             job.state = None
             self.residency.unpin(job.resident_key)
             self._update_depth_gauge()
+            self._trace_job_end(job, "failed")
             self._flight(job, "serve_failed", error=job.error[:200])
             return
         if e.poisons_state:
@@ -1040,6 +1208,7 @@ class RenderService:
             "seconds of re-dispatch backoff accrued",
         ).inc(backoff, tenant=job.tenant)
         job.ready_t = time.time()
+        self._trace_ready(job)
         self._flight(
             job, "serve_redispatch", chunk=job.cursor,
             attempt=job.attempt, poisoned=e.poisons_state,
@@ -1050,6 +1219,16 @@ class RenderService:
         # dispatching through one job's retry streak (step() only waits
         # when EVERY runnable job is inside its backoff window)
         if backoff > 0:
+            from tpu_pbrt.obs.trace import TRACE
+
+            # the backoff window's extent is known the moment it opens:
+            # an explicit-duration span shows WHY the job's timeline has
+            # a hole between this recovery and its next dispatch
+            TRACE.complete(
+                "serve/backoff", backoff * 1e6, job=job.job_id,
+                chunk=job.cursor, attempt=job.attempt,
+                trace_id=job.trace_id,
+            )
             job.not_before = time.time() + backoff
 
     def _write_preview(self, job: RenderJob) -> None:
@@ -1057,7 +1236,10 @@ class RenderService:
         from tpu_pbrt.utils import imageio
 
         t0 = time.time()
-        with TRACE.span("serve/preview", job=job.job_id, chunk=job.cursor):
+        with TRACE.span(
+            "serve/preview", job=job.job_id, chunk=job.cursor,
+            trace_id=job.trace_id,
+        ):
             img = self.preview(job.job_id)
             try:
                 imageio.write_image(job.preview_path, img)
@@ -1080,9 +1262,16 @@ class RenderService:
         # still-deferred cadence writes are superseded by the terminal
         # state below (spool checkpoints are deleted outright); the
         # block on job.state is the job's full drain either way
-        job.window = None
-        with TRACE.span("serve/finalize", job=job.job_id):
+        window, job.window = job.window, None
+        with TRACE.span(
+            "serve/finalize", job=job.job_id, trace_id=job.trace_id,
+        ):
             jax.block_until_ready(job.state)
+            if window is not None:
+                # the block above IS the tail slices' sync: their spans
+                # close complete, not aborted — the reconstructed
+                # timeline covers every chunk through the final cursor
+                window.close_spans(ok=True)
             rays = job.rays_so_far()
             ctr_total = job.snapshot_counters()
             stats: Dict[str, Any] = {
@@ -1140,10 +1329,12 @@ class RenderService:
         )
         job.status = DONE
         job.state = None  # the film lives on in result.film_state
+        self._report_nonfinite(job, ctr_total)
         self.residency.unpin(job.resident_key)
         self.residency.evict_over_budget()
         if job.spool_ckpt:
             delete_checkpoint(job.checkpoint_path)
         self._update_depth_gauge()
+        self._trace_job_end(job, "done")
         self._flight(job, "serve_done", rays=rays,
                      seconds=round(job.active_seconds, 3))
