@@ -377,16 +377,20 @@ class TestServePipelined:
         svc.drain()
 
     def test_step_now_race_backoff_window(self, monkeypatch):
-        """Satellite: step() samples time.time() ONCE. A job inside its
-        backoff window at the sampled `now` must be counted by the
-        min-not_before wait even if the clock passes not_before between
-        the two (formerly separate) samples — otherwise step() answers
-        None with work still pending."""
+        """Satellite: step() samples the decision clock ONCE. A job
+        inside its backoff window at the sampled `now` must be counted
+        by the min-not_before wait even if the clock passes not_before
+        between two would-be samples — otherwise step() answers None
+        with work still pending. The wall clock now routes through the
+        injectable utils/clock.py seam (ISSUE 17), so the race is
+        staged as an adversarial Clock whose every post-pick decision
+        sample lands past the deadline."""
         from tpu_pbrt.serve import RenderService
-        from tpu_pbrt.serve import service as service_mod
+        from tpu_pbrt.utils.clock import Clock
 
         _set(monkeypatch, 1)
-        svc = RenderService(chunk=CHUNK, seed=7)
+        clock = Clock()
+        svc = RenderService(chunk=CHUNK, seed=7, clock=clock)
         jid = svc.submit(text=TEXT, options=Options(quiet=True))
         real = time.time
         job = svc.jobs[jid]
@@ -400,11 +404,11 @@ class TestServePipelined:
             calls["n"] += 1
             return real() if calls["n"] == 1 else real() + 10.0
 
-        monkeypatch.setattr(service_mod.time, "time", fake)
-        try:
-            assert svc.step() == jid
-        finally:
-            monkeypatch.setattr(service_mod.time, "time", real)
+        monkeypatch.setattr(clock, "now", fake)
+        # no need to wait out the window for real — the post-sleep
+        # re-pick still has to see a fresh sample past the deadline
+        monkeypatch.setattr(clock, "sleep", lambda s: None)
+        assert svc.step() == jid
         job.not_before = 0.0  # let the drain below run at real speed
         svc.drain()
 

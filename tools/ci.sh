@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
-# Local CI gate (ISSUE 2 + ISSUE 3 + ISSUE 11 + ISSUE 15 satellites):
+# Local CI gate (ISSUE 2 + ISSUE 3 + ISSUE 11 + ISSUE 15 + ISSUE 17):
 #   ruff -> jaxlint (AST) -> jaxpr audit + jaxcost budget gate + shardcheck
-#   + pallascheck VMEM/grid-semantics gate -> telemetry/chaos/serve smokes
+#   + pallascheck VMEM/grid-semantics gate + protocheck protocol lint
+#   -> telemetry/chaos/serve smokes
 #   -> tpu-scope (timeline reconstruction + health verb + bench gate)
+#   -> protocheck explorer smoke (bounded interleaving/fault search)
 #   -> tier-1 pytest.
 #
 #   tools/ci.sh            # full gate
@@ -32,9 +34,11 @@ fi
 
 # fail-FAST stage: the AST lint costs ~2 s with no jax import; a lint
 # error aborts here before the multi-minute trace/compile stages below
-# (which re-lint — the duplication is the price of the early exit)
-echo "== jaxlint AST layer (python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck --no-pallascheck)"
-python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck --no-pallascheck
+# (which re-lint — the duplication is the price of the early exit).
+# --no-protocheck too: layer 6 spins up real RenderServices, so it
+# belongs with the heavier stages, not the syntax gate.
+echo "== jaxlint AST layer (python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck --no-pallascheck --no-protocheck)"
+python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck --no-pallascheck --no-protocheck
 
 # the full analysis stage runs every layer and reports EVERY failing
 # stage before exiting non-zero (ISSUE 11 satellite). pallascheck gates
@@ -43,7 +47,9 @@ python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck --no-pallaschec
 # PC-INIT/PC-OOB) and re-derives the fused caps from the VMEM model
 # (PC-CAPS); after an INTENTIONAL kernel change refresh BOTH budget
 # files with `python -m tpu_pbrt.analysis --update-budgets`.
-echo "== jaxpr audit + jaxcost budget gate + shardcheck + pallascheck (python -m tpu_pbrt.analysis)"
+# (layer 6, protocheck, also runs here: SV-* protocol lint + the
+# mutation-regression corpus + a small bounded exploration.)
+echo "== jaxpr audit + jaxcost budget gate + shardcheck + pallascheck + protocheck (python -m tpu_pbrt.analysis)"
 python -m tpu_pbrt.analysis
 
 # telemetry smoke (ISSUE 4): render a cropped cornell through the real
@@ -135,6 +141,20 @@ assert names == {"wedge", "backoff_storm", "slo_burn", "nonfinite_spike"}, names
 print(f"health verb OK ({len(names)} conditions, none firing)")
 EOF
 python tools/bench_gate.py --selftest
+
+# protocheck explorer smoke (ISSUE 17): a bounded exhaustive search
+# over decision sequences — arrival orders x pipeline depths 1-3 x
+# CHAOS fault placements x preempt/resume timings — running the REAL
+# RenderService under a VirtualClock with stub dispatches, checking
+# every PROTO-* invariant after every decision plus the PROTO-DET
+# byte-identical-replay gate. Fixed seed and node/depth budget: the
+# whole grid completes in seconds, well under the 60 s CI allowance.
+# The exported canonical-drain trace carries virtual-time stamps
+# (otherData.clock = "virtual"); scope --check must accept it.
+echo "== protocheck explorer smoke (python tools/explore.py --ci)"
+python tools/explore.py --ci --seed 0 --nodes 40 --depth 7 \
+    --trace-out "$SMOKE_DIR/explore_trace.json"
+python tools/scope.py "$SMOKE_DIR/explore_trace.json" --check
 
 # metrics registry selftest + bench trajectory report (ISSUE 10
 # satellites): the registry's record -> exposition -> lint -> percentile

@@ -317,7 +317,19 @@ def main(argv=None) -> int:
             print(f"FAIL trace: {e}", file=sys.stderr)
         return 1
     with open(args.trace) as f:
-        events = json.load(f)["traceEvents"]
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    # the export stamps its time source (obs/trace.py clock_kind): a
+    # protocheck explorer trace runs on a VirtualClock whose timeline
+    # starts near zero — timestamps are virtual decision-sequence
+    # seconds, not wall-clock epochs, and every check below is
+    # epoch-agnostic by construction (only deltas and pairing matter)
+    clock = (doc.get("otherData") or {}).get("clock", "wall")
+    if clock != "wall":
+        print(
+            f"scope: {clock}-clock trace — timestamps are simulated "
+            "decision-sequence time, not wall time"
+        )
     jobs = _group(events)
     # groups with no serve/job root span are not requests: the
     # monolithic render loop tags its slices "t:render" with no job
@@ -358,6 +370,7 @@ def main(argv=None) -> int:
     print(
         f"scope: {len(jobs)} job(s), {n_done} done, "
         f"{defects} with defects"
+        + (f" [{clock} clock]" if clock != "wall" else "")
     )
     return 1 if defects else 0
 

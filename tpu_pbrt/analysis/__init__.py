@@ -1,6 +1,6 @@
 """jaxlint — repo-specific static analysis + jaxpr audit for TPU hot paths.
 
-Five layers (ISSUE 2 + ISSUE 3 + ISSUE 11):
+Six layers (ISSUE 2 + ISSUE 3 + ISSUE 11 + ISSUE 17):
 
 - **Layer 1 (AST lint, `lint.py`)**: syntactic rules over the source tree.
   A per-module call graph seeded at `jax.jit` / `lax.while_loop` /
@@ -43,9 +43,23 @@ Five layers (ISSUE 2 + ISSUE 3 + ISSUE 11):
   no parallel-dim revisited output (PC-RACE), no read before the
   grid-step-0 seed (PC-INIT), no unprovable dynamic ref index (PC-OOB).
 
+- **Layer 6 (serve/dispatch protocol verification, `protocheck.py`)**:
+  the HOST-side state machine. Static SV-* rules (SV-CLOCK: wall clock
+  sampled outside the injected `utils/clock.py` seam or twice in a
+  deadline-scoped function; SV-DEFER: deferred checkpoint writes
+  without retirement binding; SV-VTIME: fair-share vtime written
+  outside the policy API), a seeded mutation-regression corpus of
+  three historical bugs, and a bounded exhaustive exploration
+  (`tools/explore.py`) of decision sequences — arrival orders x
+  pipeline depths x CHAOS fault placements x preempt/resume timings —
+  running the REAL RenderService under a VirtualClock and checking the
+  PROTO-* invariants (counter reconciliation, deferred-write
+  linearity, pin balance, backoff monotonicity, no wedge, schedule
+  determinism, film bit-identity) after every decision.
+
 Run `python -m tpu_pbrt.analysis` (see `__main__.py`), or the pytest
 mirrors in tests/test_jaxlint.py, test_jaxpr_audit.py, test_cost.py,
-test_shardcheck.py and test_pallascheck.py.
+test_shardcheck.py, test_pallascheck.py and test_protocheck.py.
 """
 
 from tpu_pbrt.analysis.lint import (  # noqa: F401

@@ -11,7 +11,12 @@ Stages (each skippable):
   `--no-shardcheck` skips;
 - layer 5, pallascheck VMEM-budget + grid-semantics verification of the
   fused Pallas kernels (`pallascheck.py`) — `--no-pallascheck` skips;
-  `--update-budgets` also refreshes its `vmem_budgets.json`.
+  `--update-budgets` also refreshes its `vmem_budgets.json`;
+- layer 6, protocheck serve/dispatch protocol verification
+  (`protocheck.py`) — the SV-* static rules over the protocol modules,
+  the seeded mutation-regression corpus, and a bounded interleaving/
+  fault-schedule exploration of the REAL service under a virtual clock
+  (`tools/explore.py`); `--no-protocheck` skips.
 
 Exit code 0 iff no error-severity findings in any stage that ran. A
 stage that crashes is reported as that stage's failure and the REST of
@@ -74,6 +79,10 @@ def main(argv=None) -> int:
         help="skip the Pallas VMEM-budget/grid-semantics verification",
     )
     ap.add_argument(
+        "--no-protocheck", action="store_true",
+        help="skip the serve/dispatch protocol verification layer",
+    )
+    ap.add_argument(
         "--update-budgets", action="store_true",
         help="refresh tpu_pbrt/analysis/budgets.json AND "
              "vmem_budgets.json from the current tree instead of gating "
@@ -91,7 +100,7 @@ def main(argv=None) -> int:
 
     need_jax = not (
         args.no_audit and args.no_cost and args.no_shardcheck
-        and args.no_pallascheck
+        and args.no_pallascheck and args.no_protocheck
     )
     if need_jax:
         # CPU audit/cost/shardcheck/pallascheck compile or trace tiny
@@ -156,10 +165,22 @@ def main(argv=None) -> int:
         if out is not None:
             pallas_errors, pallas_warnings = out
 
+    proto_errors: list = []
+    proto_warnings: list = []
+    if not args.no_protocheck:
+        def _proto():
+            from tpu_pbrt.analysis.protocheck import run_protocheck
+
+            return run_protocheck(root=str(repo_root))
+
+        out = _stage(_proto, proto_errors)
+        if out is not None:
+            proto_errors, proto_warnings = out
+
     errors = [v for v in violations if v.severity == "error"]
     ok = not (
         errors or audit_failures or over_budget or cost_errors
-        or shard_errors or pallas_errors
+        or shard_errors or pallas_errors or proto_errors
     )
     if args.format == "json":
         print(
@@ -191,6 +212,10 @@ def main(argv=None) -> int:
                         "errors": pallas_errors,
                         "warnings": pallas_warnings,
                     },
+                    "protocheck": {
+                        "errors": proto_errors,
+                        "warnings": proto_warnings,
+                    },
                     "pragmas": pragmas,
                     "pragma_budget": PRAGMA_BUDGET,
                     "ok": ok,
@@ -214,6 +239,10 @@ def main(argv=None) -> int:
             print(f"PALLASCHECK [warning]: {w}")
         for e in pallas_errors:
             print(f"PALLASCHECK [error]: {e}")
+        for w in proto_warnings:
+            print(f"PROTOCHECK [warning]: {w}")
+        for e in proto_errors:
+            print(f"PROTOCHECK [error]: {e}")
         if args.update_budgets and not args.no_cost:
             from tpu_pbrt.analysis.cost import BUDGETS_PATH
 
@@ -245,9 +274,14 @@ def main(argv=None) -> int:
             "pallascheck skipped" if args.no_pallascheck
             else f"{len(pallas_errors)} pallascheck error(s)"
         )
+        proto_part = (
+            "protocheck skipped" if args.no_protocheck
+            else f"{len(proto_errors)} protocheck error(s)"
+        )
         print(
             f"jaxlint: {len(errors)} error(s), {n_warn} warning(s), "
             f"{audit_part}, {cost_part}, {shard_part}, {pallas_part}, "
+            f"{proto_part}, "
             f"{pragmas} pragma suppression(s) (budget {PRAGMA_BUDGET})"
         )
         if over_budget:

@@ -166,6 +166,28 @@ def parse_plan(spec: str) -> List[Fault]:
     return faults
 
 
+def protocol_fault_space(n_chunks: int = 2) -> List[str]:
+    """The fault plans the serve-protocol explorer (analysis layer 6,
+    tools/explore.py) crosses its decision sequences with — drawn from
+    THIS grammar so every explored fault schedule is also a plan a user
+    can hand to --faults / TPU_PBRT_FAULTS and replay outside the
+    explorer. Host-side sites only: dispatch fail/poison exercise the
+    recovery ladder's clean-retry and rollback/restart arms, ckpt
+    torn/crash exercise the .prev fallback under the deferred-write
+    protocol. ("" = the undisturbed schedule every faulted end state is
+    compared against.) Each entry is parse_plan-validated here, at
+    definition time."""
+    specs = [""]
+    for c in range(max(int(n_chunks), 1)):
+        specs.append(f"dispatch:fail@chunk={c}")
+        specs.append(f"dispatch:poison@chunk={c}")
+    specs.append("ckpt:torn@write=1")
+    specs.append("ckpt:crash@write=1")
+    for s in specs:
+        parse_plan(s)
+    return specs
+
+
 class ChaosRegistry:
     """Process-global injection-point registry. All decisions are host-
     side and deterministic: plan + seed fully determine which dispatch

@@ -300,9 +300,13 @@ class DispatchWindow:
     completed work is never lost to an unrelated chunk's retry streak.
     """
 
-    __slots__ = ("depth", "slices", "deferred", "on_wait", "span_name")
+    __slots__ = (
+        "depth", "slices", "deferred", "on_wait", "span_name", "clock",
+    )
 
-    def __init__(self, depth: int, on_wait=None, span_name: str = ""):
+    def __init__(
+        self, depth: int, on_wait=None, span_name: str = "", clock=None,
+    ):
         self.depth = max(1, int(depth))
         #: [(chunk index, per-chunk device sync handle, trace span|None)]
         self.slices: list = []
@@ -310,6 +314,12 @@ class DispatchWindow:
         self.deferred: list = []
         self.on_wait = on_wait  # dt -> None (device_wait attribution)
         self.span_name = span_name
+        # injected time source (utils/clock.py) — only for device-wait
+        # attribution, but under a VirtualClock even measurement must
+        # not touch the wall (protocheck's determinism contract)
+        if clock is None:
+            from tpu_pbrt.utils.clock import WALL as clock  # noqa: N811
+        self.clock = clock
 
     def __len__(self) -> int:
         return len(self.slices)
@@ -366,7 +376,7 @@ class DispatchWindow:
             for k in ("trace_id", "span_id")
             if span and k in span
         }
-        t0 = time.perf_counter()
+        t0 = self.clock.monotonic()
         ok = False
         try:
             if self.span_name:
@@ -381,7 +391,7 @@ class DispatchWindow:
             ) from e
         finally:
             if self.on_wait is not None:
-                self.on_wait(time.perf_counter() - t0)
+                self.on_wait(self.clock.monotonic() - t0)
             self._close_span(span, ok)
         while self.deferred and self.deferred[0][0] <= chunk + 1:
             self.deferred.pop(0)[1]()

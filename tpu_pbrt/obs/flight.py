@@ -19,14 +19,16 @@ from __future__ import annotations
 
 import json
 import os
-import time
 from typing import Any, Dict, List, Optional
 
 
 class FlightRecorder:
     def __init__(self):
         self._path: Optional[str] = None
-        self._t0 = time.time()
+        from tpu_pbrt.utils.clock import WALL
+
+        self._clock = WALL
+        self._t0 = self._clock.peek()
         self.last_phase: Optional[str] = None
         self.last_counters: Optional[Dict[str, Any]] = None
 
@@ -38,6 +40,19 @@ class FlightRecorder:
         self._path = path or None
         if t0 is not None:
             self._t0 = t0
+
+    def set_clock(self, clock=None):
+        """Inject a time source (utils/clock.py; None restores the wall
+        clock) and rebase the elapsed_s baseline onto it. Under a
+        VirtualClock every heartbeat stamps virtual seconds — monotone
+        nondecreasing along the decision sequence — instead of
+        interleaving real time.time() into the lines of a simulated
+        run. peek(): flight recording must never advance the timeline
+        it is observing."""
+        from tpu_pbrt.utils.clock import WALL
+
+        self._clock = clock if clock is not None else WALL
+        self._t0 = self._clock.peek()
 
     @property
     def path(self) -> Optional[str]:
@@ -77,9 +92,10 @@ class FlightRecorder:
         fields. Opened/flushed/closed per line — crash-safe by
         construction — behind the same rotation cap whichever file it
         lands in."""
+        now = self._clock.peek()
         line = {
-            "t": round(time.time(), 3),
-            "elapsed_s": round(time.time() - self._t0, 3),
+            "t": round(now, 3),
+            "elapsed_s": round(now - self._t0, 3),
             "phase": phase,
         }
         # reserved keys win: a caller kwarg must not clobber the

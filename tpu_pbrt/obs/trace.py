@@ -36,7 +36,6 @@ from __future__ import annotations
 
 import json
 import os
-import time
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
@@ -52,13 +51,41 @@ class TraceRecorder:
     def __init__(self):
         self._events: List[Dict[str, Any]] = []
         self._path: Optional[str] = None
-        self._t0 = time.perf_counter()
+        from tpu_pbrt.utils.clock import WALL
+
+        self._clock = WALL
+        self._t0 = self._clock.monotonic()
         self._next_span = 0
 
     # -- configuration -----------------------------------------------------
     def configure(self, path: Optional[str]):
         """Set (or clear) the export path; the --trace flag lands here."""
         self._path = path or None
+
+    def set_clock(self, clock=None):
+        """Inject a time source (utils/clock.py; None restores the wall
+        clock) and REBASE the timestamp origin onto it. The rebase is
+        the load-bearing part: a VirtualClock's timeline starts near 0,
+        and subtracting a wall-clock `_t0` captured at import would
+        produce negative `ts` — which validate_trace rightly rejects.
+        Rebasing keeps every recorder the explorer arms emitting
+        monotone nonnegative virtual-time stamps."""
+        from tpu_pbrt.utils.clock import WALL
+
+        self._clock = clock if clock is not None else WALL
+        self._t0 = self._clock.monotonic()
+
+    @property
+    def clock_kind(self) -> str:
+        """"wall" or the injected clock's class name (lowercased) — the
+        export stamps this so tools/scope.py can tell a virtual-time
+        explorer trace from a production one."""
+        from tpu_pbrt.utils.clock import WALL
+
+        if self._clock is WALL:
+            return "wall"
+        kind = type(self._clock).__name__.lower().removesuffix("clock")
+        return kind or "wall"
 
     @property
     def path(self) -> Optional[str]:
@@ -74,7 +101,7 @@ class TraceRecorder:
 
     def reset(self):
         self._events = []
-        self._t0 = time.perf_counter()
+        self._t0 = self._clock.monotonic()
         self._next_span = 0
 
     # -- ids ---------------------------------------------------------------
@@ -95,7 +122,10 @@ class TraceRecorder:
 
     # -- recording ---------------------------------------------------------
     def _now_us(self) -> float:
-        return (time.perf_counter() - self._t0) * 1e6
+        # monotonic(): a non-perturbing read — recording a span must
+        # never advance a virtual timeline (arming the trace cannot
+        # change the scheduling decisions it observes)
+        return (self._clock.monotonic() - self._t0) * 1e6
 
     @contextmanager
     def span(self, name: str, **args):
@@ -201,7 +231,10 @@ class TraceRecorder:
         doc = {
             "traceEvents": self._events,
             "displayTimeUnit": "ms",
-            "otherData": {"tool": "tpu-pbrt obs.trace"},
+            "otherData": {
+                "tool": "tpu-pbrt obs.trace",
+                "clock": self.clock_kind,
+            },
         }
         # atomic tmp+rename (the checkpoint.py pattern): a crash mid-
         # export must leave the previous valid export intact, not a

@@ -45,6 +45,27 @@ _FORMAT_VERSION = 4
 #: versions load_checkpoint still understands
 _COMPAT_VERSIONS = (2, 3, 4)
 
+#: write observers: fn(path, next_chunk, rays) called whenever a VALID
+#: checkpoint is durably published (after the rename; never for the
+#: simulated crash/torn chaos outcomes, which publish nothing usable).
+#: The protocol checker (analysis layer 6) hooks here to verify
+#: deferred-write linearity — per path the published cursor must be
+#: monotone nondecreasing, so a superseded cadence write replayed after
+#: a park shows up as a cursor regression — without monkeypatching the
+#: writer it is auditing.
+_WRITE_OBSERVERS: list = []
+
+
+def register_write_observer(fn) -> None:
+    _WRITE_OBSERVERS.append(fn)
+
+
+def unregister_write_observer(fn) -> None:
+    try:
+        _WRITE_OBSERVERS.remove(fn)
+    except ValueError:
+        pass
+
 
 class CorruptCheckpointError(ValueError):
     """The checkpoint file cannot be trusted (torn/short/bit-flipped —
@@ -220,6 +241,8 @@ def save_checkpoint(
     _rotate_prev(path)
     os.replace(actual_tmp, path)
     _fsync_dir(path)
+    for obs in _WRITE_OBSERVERS:
+        obs(path, int(next_chunk), int(rays_so_far))
 
 
 def delete_checkpoint(path: str) -> None:

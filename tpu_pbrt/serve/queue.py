@@ -52,6 +52,15 @@ class FairScheduler:
         self._tenants: Dict[str, TenantShare] = {}
 
     # -- tenants -----------------------------------------------------------
+    def _set_vtime(self, ts: TenantShare, vtime: float) -> None:
+        """The ONLY sanctioned vtime writer. The fairness invariants
+        (no banked credit, vtime monotone per tenant under charge) live
+        in the three callers — tenant()'s floor init, reenter()'s busy
+        clamp, charge()'s weighted advance; a vtime write anywhere else
+        is a policy bypass, and the SV-VTIME lint rule (analysis layer
+        6, protocheck) rejects it."""
+        ts.vtime = float(vtime)
+
     def tenant(self, name: str) -> TenantShare:
         ts = self._tenants.get(name)
         if ts is None:
@@ -61,7 +70,8 @@ class FairScheduler:
             floor = min(
                 (t.vtime for t in self._tenants.values()), default=0.0
             )
-            ts = self._tenants[name] = TenantShare(vtime=floor)
+            ts = self._tenants[name] = TenantShare()
+            self._set_vtime(ts, floor)
         return ts
 
     def set_weight(self, name: str, weight: float) -> None:
@@ -84,7 +94,7 @@ class FairScheduler:
             if t != name and t in self._tenants
         ]
         if floor:
-            ts.vtime = max(ts.vtime, min(floor))
+            self._set_vtime(ts, max(ts.vtime, min(floor)))
 
     def _tiebreak(self, tenant: str) -> int:
         return zlib.crc32(f"{self.seed}:{tenant}".encode())
@@ -134,7 +144,7 @@ class FairScheduler:
     def charge(self, tenant: str, cost: float = 1.0) -> None:
         """Account one dispatched chunk-slice to `tenant`."""
         ts = self.tenant(tenant)
-        ts.vtime += cost / ts.weight
+        self._set_vtime(ts, ts.vtime + cost / ts.weight)
         ts.slices += 1
         from tpu_pbrt.obs.trace import TRACE
 

@@ -246,6 +246,14 @@ class ResidencyCache:
         return True
 
     # -- introspection -----------------------------------------------------
+    def pin_counts(self) -> Dict[str, int]:
+        """key -> live pin count. The protocol checker's pin-balance
+        invariant reads this after every decision: each key's pins must
+        equal the number of non-terminal jobs holding it, and every
+        count must be zero once all jobs are terminal (a leak here is a
+        scene the LRU can never evict)."""
+        return {k: e.pins for k, e in self._entries.items()}
+
     def stats(self) -> Dict[str, Any]:
         return {
             "entries": len(self._entries),

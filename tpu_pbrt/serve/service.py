@@ -42,7 +42,6 @@ daemon + --selftest), and `tpu-pbrt --serve` (main.py).
 from __future__ import annotations
 
 import os
-import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -72,6 +71,7 @@ from tpu_pbrt.serve.residency import (
     ResidencyCache,
     scene_source_key,
 )
+from tpu_pbrt.utils.clock import WALL
 
 # job lifecycle. queued: never dispatched. active: film state in memory.
 # parked: progress on disk (policy preemption), schedulable. paused:
@@ -273,8 +273,15 @@ class RenderService:
         spool_dir: Optional[str] = None,
         quiet: bool = True,
         slo: Optional[SloPolicy] = None,
+        clock=None,
     ):
         self.mesh = mesh
+        # the protocol's only time source (utils/clock.py): every
+        # scheduling decision, backoff deadline and wait measurement
+        # samples THIS object, so a VirtualClock makes a whole service
+        # run a pure function of the decision sequence (protocheck's
+        # model-extraction seam). Default WALL = pre-seam behavior.
+        self.clock = clock if clock is not None else WALL
         if chunk is None:
             chunk = cfg.serve_chunk
         self.chunk = chunk
@@ -325,6 +332,12 @@ class RenderService:
         # the wedge signal (runnable work, no progress)
         self.health_steps = 0
         self.last_progress_step = 0
+
+    def _now(self) -> float:
+        """One DECISION sample of the injected clock. SV-CLOCK contract:
+        a function that reasons about runnability or backoff deadlines
+        calls this at most once and threads the value through."""
+        return self.clock.now()
 
     # -- submit ------------------------------------------------------------
     def submit(
@@ -441,7 +454,7 @@ class RenderService:
                 if j.status in _RUNNABLE
             },
         )
-        job.ready_t = time.time()
+        job.ready_t = self._now()
         self.jobs[job_id] = job
         # tpu-scope: the job's trace context. The root async span opens
         # here and closes at the terminal outcome; every span the job
@@ -539,7 +552,7 @@ class RenderService:
         active = [j for j in self.jobs.values() if j.state is not None]
         out = []
         if now is None:
-            now = time.time()
+            now = self._now()
         for j in self.jobs.values():
             if j.status not in _RUNNABLE:
                 continue
@@ -564,21 +577,36 @@ class RenderService:
         # job whose not_before falls between two samples is excluded
         # from both — step() would answer None with work still pending
         self.health_steps += 1
-        now = time.time()
+        now = self._now()
         job = self.scheduler.pick(self._runnable(now))
         if job is None:
-            # nothing dispatchable — but a job whose backoff window is
-            # still open is WORK, not idleness: wait out the earliest
-            # deadline so drain() doesn't return with jobs unfinished
-            waiting = [
-                j.not_before for j in self.jobs.values()
-                if j.status in _RUNNABLE and j.not_before > now
-            ]
-            if waiting:
-                time.sleep(max(min(waiting) - time.time(), 0.0))
-                job = self.scheduler.pick(self._runnable())
+            job = self._await_backoff(now)
             if job is None:
                 return None
+        return self._step_job(job)
+
+    def _await_backoff(self, now: float) -> Optional[RenderJob]:
+        """Nothing was dispatchable at `now` — but a job whose backoff
+        window is still open is WORK, not idleness: wait out the
+        earliest deadline so drain() doesn't return with jobs
+        unfinished. `now` is step's single decision sample; the one
+        fresh sample after the sleep is this function's own (SV-CLOCK:
+        one per deadline-reasoning scope)."""
+        waiting = [
+            j.not_before for j in self.jobs.values()
+            if j.status in _RUNNABLE and j.not_before > now
+        ]
+        if not waiting:
+            return None
+        self.clock.sleep(max(min(waiting) - now, 0.0))
+        return self.scheduler.pick(self._runnable(self._now()))
+
+    def _step_job(self, job: RenderJob) -> str:
+        """Run the selected job's slice: activation, dispatch with the
+        recovery ladder, prefetch overlap, and the job-level failure
+        firewall. Split from step() so the selection logic above stays
+        a pure clock/deadline function (the piece protocheck's mutation
+        corpus perturbs) while this body owns the side effects."""
         try:
             self._activate(job)
             self._dispatch_slice(job)
@@ -697,7 +725,7 @@ class RenderService:
         if job.status != PAUSED:
             raise ValueError(f"job {job_id} is {job.status}, not paused")
         job.status = PARKED if job.cursor else QUEUED
-        job.ready_t = time.time()
+        job.ready_t = self._now()
         self._trace_ready(job)
         METRICS.counter(
             "serve_resumes_total", "paused jobs resumed"
@@ -1015,7 +1043,7 @@ class RenderService:
 
         plan = job.plan
         c = job.cursor
-        t0 = time.time()
+        t0 = self._now()
         if job.window is None:
             tracer = plan.tracer
 
@@ -1033,6 +1061,7 @@ class RenderService:
                 plan.pipeline_depth,
                 on_wait=on_wait,
                 span_name="serve/slice_retire",
+                clock=self.clock,
             )
         sid = f"{job.trace_id}/c{c}"
         if job.ready_t:
@@ -1146,7 +1175,7 @@ class RenderService:
         # bounded device sync (at depth 1 that is the whole chunk
         # compute — the pre-pipeline meaning), not just the async
         # enqueue + bookkeeping
-        now = time.time()
+        now = self._now()
         job.active_seconds += now - t0
         _slice_hist().observe(
             now - t0, tenant=job.tenant,
@@ -1207,7 +1236,11 @@ class RenderService:
             "serve_redispatch_backoff_seconds_total",
             "seconds of re-dispatch backoff accrued",
         ).inc(backoff, tenant=job.tenant)
-        job.ready_t = time.time()
+        # one decision sample covers both the ready time and the backoff
+        # deadline (SV-CLOCK: recovery reasons about not_before, so it
+        # samples the clock exactly once)
+        now = self._now()
+        job.ready_t = now
         self._trace_ready(job)
         self._flight(
             job, "serve_redispatch", chunk=job.cursor,
@@ -1229,13 +1262,13 @@ class RenderService:
                 chunk=job.cursor, attempt=job.attempt,
                 trace_id=job.trace_id,
             )
-            job.not_before = time.time() + backoff
+            job.not_before = now + backoff
 
     def _write_preview(self, job: RenderJob) -> None:
         from tpu_pbrt.obs.trace import TRACE
         from tpu_pbrt.utils import imageio
 
-        t0 = time.time()
+        t0 = self.clock.monotonic()
         with TRACE.span(
             "serve/preview", job=job.job_id, chunk=job.cursor,
             trace_id=job.trace_id,
@@ -1251,7 +1284,7 @@ class RenderService:
         METRICS.histogram(
             "serve_preview_seconds",
             "preview latency: live-film develop + image write",
-        ).observe(time.time() - t0, tenant=job.tenant)
+        ).observe(self.clock.monotonic() - t0, tenant=job.tenant)
         self._flight(job, "serve_preview", chunk=job.cursor)
 
     def _finalize(self, job: RenderJob) -> None:
