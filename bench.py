@@ -222,7 +222,11 @@ def static_wave_cost(res: int, spp: int, timeout_s: float = 150.0) -> dict:
                           # pallascheck's fused-kernel VMEM footprint +
                           # budget headroom fraction (ISSUE 11) — absent
                           # from pre-PR-11 subprocess output, tolerated
-                          "static_vmem_per_wave", "vmem_headroom")
+                          "static_vmem_per_wave", "vmem_headroom",
+                          # hbmcheck's per-job serve footprint + HBM
+                          # budget headroom fraction (ISSUE 18) — same
+                          # tolerance for pre-PR-18 subprocess output
+                          "static_hbm_per_job", "hbm_headroom")
                 if k in d
             }
         print(

@@ -235,9 +235,9 @@ class TestMutationCorpus:
         with pytest.raises(KeyError):
             pc.mutation_case("not-a-mutation")
 
-    def test_corpus_covers_the_three_historical_bugs(self):
+    def test_corpus_covers_the_seeded_bugs(self):
         assert {c.expect for c in pc.MUTATION_CASES} == {
-            "PROTO-WEDGE", "PROTO-VTIME", "PROTO-DEFER",
+            "PROTO-WEDGE", "PROTO-VTIME", "PROTO-DEFER", "PROTO-HBM",
         }
 
 

@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
-# Local CI gate (ISSUE 2 + ISSUE 3 + ISSUE 11 + ISSUE 15 + ISSUE 17):
+# Local CI gate (ISSUE 2 + 3 + 11 + 15 + 17 + 18):
 #   ruff -> jaxlint (AST) -> jaxpr audit + jaxcost budget gate + shardcheck
 #   + pallascheck VMEM/grid-semantics gate + protocheck protocol lint
+#   + hbmcheck HBM residency/liveness/capacity gate
 #   -> telemetry/chaos/serve smokes
 #   -> tpu-scope (timeline reconstruction + health verb + bench gate)
 #   -> protocheck explorer smoke (bounded interleaving/fault search)
@@ -35,10 +36,11 @@ fi
 # fail-FAST stage: the AST lint costs ~2 s with no jax import; a lint
 # error aborts here before the multi-minute trace/compile stages below
 # (which re-lint — the duplication is the price of the early exit).
-# --no-protocheck too: layer 6 spins up real RenderServices, so it
-# belongs with the heavier stages, not the syntax gate.
-echo "== jaxlint AST layer (python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck --no-pallascheck --no-protocheck)"
-python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck --no-pallascheck --no-protocheck
+# --no-protocheck/--no-hbmcheck too: layers 6-7 spin up real
+# RenderServices / evaluate the serve memory model, so they belong with
+# the heavier stages, not the syntax gate.
+echo "== jaxlint AST layer (python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck --no-pallascheck --no-protocheck --no-hbmcheck)"
+python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck --no-pallascheck --no-protocheck --no-hbmcheck
 
 # the full analysis stage runs every layer and reports EVERY failing
 # stage before exiting non-zero (ISSUE 11 satellite). pallascheck gates
@@ -48,8 +50,12 @@ python -m tpu_pbrt.analysis --no-audit --no-cost --no-shardcheck --no-pallaschec
 # (PC-CAPS); after an INTENTIONAL kernel change refresh BOTH budget
 # files with `python -m tpu_pbrt.analysis --update-budgets`.
 # (layer 6, protocheck, also runs here: SV-* protocol lint + the
-# mutation-regression corpus + a small bounded exploration.)
-echo "== jaxpr audit + jaxcost budget gate + shardcheck + pallascheck + protocheck (python -m tpu_pbrt.analysis)"
+# mutation-regression corpus + a small bounded exploration. layer 7,
+# hbmcheck, gates the serve stack's static HBM model — worst-case
+# footprint vs the platform capacity table + the committed
+# hbm_budgets.json, terminal-path buffer release, residency-estimate
+# accuracy, donation-alias dedup.)
+echo "== jaxpr audit + jaxcost budget gate + shardcheck + pallascheck + protocheck + hbmcheck (python -m tpu_pbrt.analysis)"
 python -m tpu_pbrt.analysis
 
 # telemetry smoke (ISSUE 4): render a cropped cornell through the real
@@ -155,6 +161,23 @@ echo "== protocheck explorer smoke (python tools/explore.py --ci)"
 python tools/explore.py --ci --seed 0 --nodes 40 --depth 7 \
     --trace-out "$SMOKE_DIR/explore_trace.json"
 python tools/scope.py "$SMOKE_DIR/explore_trace.json" --check
+
+# hbm leak-mutant smoke (ISSUE 18): re-introduce the seeded park-path
+# film leak through the REAL entry point and require PROTO-HBM to flag
+# it by name. `--mutate` exits 1 ON DETECTION, so the gate inverts:
+# exit 0 here means the leak went unnoticed and the HBM liveness gate
+# has rotted.
+echo "== hbm leak-mutant smoke (python tools/explore.py --mutate park-skips-film-release)"
+if python tools/explore.py --mutate park-skips-film-release > "$SMOKE_DIR/hbm_mutant.log" 2>&1; then
+    echo "   seeded HBM leak mutant NOT detected — PROTO-HBM gate rotted"
+    cat "$SMOKE_DIR/hbm_mutant.log"
+    exit 1
+fi
+grep -q "PROTOCHECK VIOLATION PROTO-HBM" "$SMOKE_DIR/hbm_mutant.log" || {
+    echo "   mutant flagged, but not by PROTO-HBM:"
+    cat "$SMOKE_DIR/hbm_mutant.log"
+    exit 1
+}
 
 # metrics registry selftest + bench trajectory report (ISSUE 10
 # satellites): the registry's record -> exposition -> lint -> percentile

@@ -16,7 +16,14 @@ Stages (each skippable):
   (`protocheck.py`) — the SV-* static rules over the protocol modules,
   the seeded mutation-regression corpus, and a bounded interleaving/
   fault-schedule exploration of the REAL service under a virtual clock
-  (`tools/explore.py`); `--no-protocheck` skips.
+  (`tools/explore.py`); `--no-protocheck` skips;
+- layer 7, hbmcheck static HBM residency/liveness/capacity
+  verification of the serve stack (`hbmcheck.py`) — the HC-* rules:
+  worst-case footprint vs the per-platform capacity table + the
+  committed `hbm_budgets.json` (HC-CAP, refreshed by
+  `--update-budgets`), terminal-path device-buffer release (HC-LEAK),
+  residency-estimate accuracy (HC-ACCT), and donation-alias dedup
+  (HC-ALIAS); `--no-hbmcheck` skips.
 
 Exit code 0 iff no error-severity findings in any stage that ran. A
 stage that crashes is reported as that stage's failure and the REST of
@@ -83,10 +90,14 @@ def main(argv=None) -> int:
         help="skip the serve/dispatch protocol verification layer",
     )
     ap.add_argument(
+        "--no-hbmcheck", action="store_true",
+        help="skip the static HBM residency/liveness/capacity layer",
+    )
+    ap.add_argument(
         "--update-budgets", action="store_true",
-        help="refresh tpu_pbrt/analysis/budgets.json AND "
-             "vmem_budgets.json from the current tree instead of gating "
-             "against them (commit the result)",
+        help="refresh tpu_pbrt/analysis/budgets.json, "
+             "vmem_budgets.json AND hbm_budgets.json from the current "
+             "tree instead of gating against them (commit the result)",
     )
     ap.add_argument("--format", choices=("text", "json"), default="text")
     args = ap.parse_args(argv)
@@ -101,6 +112,7 @@ def main(argv=None) -> int:
     need_jax = not (
         args.no_audit and args.no_cost and args.no_shardcheck
         and args.no_pallascheck and args.no_protocheck
+        and args.no_hbmcheck
     )
     if need_jax:
         # CPU audit/cost/shardcheck/pallascheck compile or trace tiny
@@ -177,10 +189,24 @@ def main(argv=None) -> int:
         if out is not None:
             proto_errors, proto_warnings = out
 
+    hbm_errors: list = []
+    hbm_warnings: list = []
+    if not args.no_hbmcheck:
+        def _hbm():
+            from tpu_pbrt.analysis.hbmcheck import run_hbmcheck
+
+            return run_hbmcheck(
+                update=args.update_budgets, root=str(repo_root)
+            )
+
+        out = _stage(_hbm, hbm_errors)
+        if out is not None:
+            hbm_errors, hbm_warnings = out
+
     errors = [v for v in violations if v.severity == "error"]
     ok = not (
         errors or audit_failures or over_budget or cost_errors
-        or shard_errors or pallas_errors or proto_errors
+        or shard_errors or pallas_errors or proto_errors or hbm_errors
     )
     if args.format == "json":
         print(
@@ -216,6 +242,10 @@ def main(argv=None) -> int:
                         "errors": proto_errors,
                         "warnings": proto_warnings,
                     },
+                    "hbmcheck": {
+                        "errors": hbm_errors,
+                        "warnings": hbm_warnings,
+                    },
                     "pragmas": pragmas,
                     "pragma_budget": PRAGMA_BUDGET,
                     "ok": ok,
@@ -243,6 +273,10 @@ def main(argv=None) -> int:
             print(f"PROTOCHECK [warning]: {w}")
         for e in proto_errors:
             print(f"PROTOCHECK [error]: {e}")
+        for w in hbm_warnings:
+            print(f"HBMCHECK [warning]: {w}")
+        for e in hbm_errors:
+            print(f"HBMCHECK [error]: {e}")
         if args.update_budgets and not args.no_cost:
             from tpu_pbrt.analysis.cost import BUDGETS_PATH
 
@@ -255,6 +289,14 @@ def main(argv=None) -> int:
             print(
                 f"pallascheck: VMEM budgets refreshed -> "
                 f"{VMEM_BUDGETS_PATH}"
+            )
+        if args.update_budgets and not args.no_hbmcheck:
+            from tpu_pbrt.analysis.hbmcheck import (
+                BUDGETS_PATH as HBM_BUDGETS_PATH,
+            )
+
+            print(
+                f"hbmcheck: HBM budgets refreshed -> {HBM_BUDGETS_PATH}"
             )
         n_warn = len(violations) - len(errors)
         # a SKIPPED stage must not read as a clean one in the summary
@@ -278,10 +320,14 @@ def main(argv=None) -> int:
             "protocheck skipped" if args.no_protocheck
             else f"{len(proto_errors)} protocheck error(s)"
         )
+        hbm_part = (
+            "hbmcheck skipped" if args.no_hbmcheck
+            else f"{len(hbm_errors)} hbmcheck error(s)"
+        )
         print(
             f"jaxlint: {len(errors)} error(s), {n_warn} warning(s), "
             f"{audit_part}, {cost_part}, {shard_part}, {pallas_part}, "
-            f"{proto_part}, "
+            f"{proto_part}, {hbm_part}, "
             f"{pragmas} pragma suppression(s) (budget {PRAGMA_BUDGET})"
         )
         if over_budget:

@@ -936,6 +936,18 @@ def _main(argv=None) -> int:
             import sys
 
             print(f"bench-wave vmem model failed: {e}", file=sys.stderr)
+        try:
+            # the HBM half (hbmcheck, ISSUE 18): the static per-job
+            # serve footprint + the fraction of the smallest platform's
+            # HBM budget free at current knobs — advisory like the VMEM
+            # block, the roofline fields above survive any drift
+            from tpu_pbrt.analysis.hbmcheck import bench_fields
+
+            line.update(bench_fields(rx=args.res, ry=args.res))
+        except Exception as e:  # noqa: BLE001
+            import sys
+
+            print(f"bench-wave hbm model failed: {e}", file=sys.stderr)
         print(json.dumps(line))
         return 0
     errors, warnings, rollups, _ = run_cost(update=args.update_budgets)

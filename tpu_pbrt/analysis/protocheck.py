@@ -7,8 +7,8 @@ serve/dispatch protocol itself: the state machine formed by
 ``serve/service.py`` (job lifecycle + recovery ladder),
 ``serve/queue.py`` (WFQ policy), and ``integrators/common.py``'s
 ``DispatchWindow`` (pipelined in-flight slices + deferred checkpoint
-writes). Three historical bugs motivate it, each now a named seeded
-mutant in the regression corpus (``MUTATION_CASES``):
+writes). Four seeded bugs anchor it, each a named mutant in the
+regression corpus (``MUTATION_CASES``):
 
 - **PR-13 clock double-sample wedge** — ``step()`` sampled the wall
   clock once for the runnable filter and again for the backoff-wait
@@ -27,6 +27,14 @@ mutant in the regression corpus (``MUTATION_CASES``):
   PROTO-DEFER watches ``parallel/checkpoint``'s write-observer seam;
   the ``defer-replay-after-park`` mutant replays a captured deferred
   write and is flagged by cursor regression.
+- **park-path HBM leak (ISSUE 18)** — a park that writes the durable
+  emergency checkpoint but skips the film release strands one
+  film-state carry in HBM per preemption. PROTO-HBM evaluates
+  hbmcheck's (layer 7) memory model on the live service after every
+  decision: the watermark must stay under the scenario's static worst
+  case, parked/terminal jobs must hold no device buffers, and the
+  model must return to baseline at drain. The
+  ``park-skips-film-release`` mutant reintroduces the leak.
 
 Two halves:
 
@@ -618,6 +626,10 @@ class ProtocolModel:
         self.log: List[str] = []
         self._unsubmitted = set(range(len(scenario.jobs)))
         self._done_checked: set = set()
+        # PROTO-HBM (ISSUE 18): the layer-7 memory model evaluated on
+        # the live service — peak watermark + cached static worst case
+        self.hbm_peak = 0
+        self._hbm_worst: Optional[int] = None
         self._obs = self._on_ckpt_write
         ckpt.register_write_observer(self._obs)
         # satellite: the recorders run on the SAME virtual timeline, so
@@ -860,6 +872,108 @@ class ProtocolModel:
                     f"the sequential schedule's (interleaving or rollback "
                     f"changed the accumulation)",
                 ))
+        # PROTO-HBM (ISSUE 18): hbmcheck's static memory model,
+        # cross-checked dynamically — the modeled watermark must stay
+        # under the scenario's static worst case, parked/terminal jobs
+        # must hold no device buffers, and the watermark must return to
+        # baseline (resident scenes only) once the scenario drains
+        from tpu_pbrt.serve.service import CANCELLED, FAILED, PARKED, PAUSED
+
+        held, total = self._modeled_hbm()
+        self.hbm_peak = max(self.hbm_peak, total)
+        worst = self._static_worst_hbm()
+        if total > worst:
+            self.violations.append((
+                "PROTO-HBM",
+                f"modeled HBM watermark {total} B exceeds the static "
+                f"worst case {worst} B after {decision!r} — the serve "
+                f"stack holds more device memory than layer 7's model "
+                f"admits",
+            ))
+        for j in svc.jobs.values():
+            if (
+                j.status in (PARKED, PAUSED, CANCELLED, FAILED)
+                and j.state is not None
+            ):
+                self.violations.append((
+                    "PROTO-HBM",
+                    f"job {j.job_id} ({j.status}) retains its film carry "
+                    f"— the park/terminal path must release HBM after "
+                    f"the durable write lands",
+                ))
+            if j.status in _TERMINAL:
+                n_ctr = (
+                    len(j.ray_counts) + len(j.occ_counts)
+                    + len(j.ctr_counts) + len(j.nf_counts)
+                )
+                if n_ctr or j.window is not None:
+                    w = "live" if j.window is not None else "none"
+                    self.violations.append((
+                        "PROTO-HBM",
+                        f"terminal job {j.job_id} ({j.status}) retains "
+                        f"{n_ctr} per-slice counter buffer(s), window="
+                        f"{w} — terminal paths must drop every device "
+                        f"reference",
+                    ))
+        if (
+            svc.jobs and not self._unsubmitted
+            and all(j.status in _TERMINAL for j in svc.jobs.values())
+            and held != 0
+        ):
+            self.violations.append((
+                "PROTO-HBM",
+                f"drained: every job terminal but the modeled job-held "
+                f"HBM is {held} B, not 0 — the watermark did not return "
+                f"to baseline (resident scenes only)",
+            ))
+
+    def _modeled_hbm(self) -> Tuple[int, int]:
+        """(job-held bytes, total bytes) of the layer-7 memory model
+        evaluated on the LIVE service: film carries (job.state), the
+        un-donated in-flight window slices, and the per-slice counter
+        scalars, plus resident scene bytes for the total. Terminal
+        results (RenderResult.film_state) are intentional retention and
+        excluded — the drain baseline is resident scenes only."""
+        from tpu_pbrt.analysis.hbmcheck import film_state_bytes
+
+        held = 0
+        for j in self.svc.jobs.values():
+            fb = 0
+            if j.plan is not None:
+                rx, ry = j.plan.film.full_resolution
+                fb = film_state_bytes(rx, ry)
+            if j.state is not None:
+                held += fb
+            if (
+                j.window is not None
+                and getattr(j.plan, "pipeline_depth", 1) > 1
+            ):
+                held += len(j.window) * fb
+            held += 8 * (
+                len(j.ray_counts) + len(j.occ_counts)
+                + len(j.ctr_counts) + len(j.nf_counts)
+            )
+        return held, held + self.svc.residency.total_bytes()
+
+    def _static_worst_hbm(self) -> int:
+        """hbmcheck's static worst case specialized to this scenario —
+        the bound PROTO-HBM holds the dynamic watermark to: per job,
+        one stub resident scene + the live film carries of its depth +
+        a full complement of per-slice counters."""
+        if self._hbm_worst is None:
+            from tpu_pbrt.analysis.hbmcheck import (
+                COUNTER_BYTES_PER_SLICE, film_state_bytes,
+            )
+            from tpu_pbrt.integrators.common import live_film_carries
+
+            fb = film_state_bytes(2, 2)  # the stub harness film
+            total = 0
+            for spec in self.scenario.jobs:
+                total += fb  # scene_hbm_bytes of a StubScene (dev={})
+                total += live_film_carries(spec.depth) * fb
+                total += spec.n_chunks * COUNTER_BYTES_PER_SLICE
+            self._hbm_worst = total
+        return self._hbm_worst
 
     # -- artifacts ----------------------------------------------------------
     def _log_line(self, decision: tuple, outcome: str) -> None:
@@ -1006,6 +1120,29 @@ def _mut_defer_replay():
         S.RenderService._park = orig
 
 
+@contextmanager
+def _mut_park_leak():
+    """Seeded ISSUE-18 leak: the park path writes the durable emergency
+    checkpoint but SKIPS the film release — every preemption strands
+    one film-state carry in HBM (the 'known suspect' hbmcheck's
+    HC-LEAK static rule and PROTO-HBM's dynamic watermark both
+    target)."""
+    from tpu_pbrt.serve import service as S
+
+    orig = S.RenderService._park
+
+    def _park(self, job):
+        carry = job.state
+        orig(self, job)
+        job.state = carry  # the release the mutant skips
+
+    S.RenderService._park = _park
+    try:
+        yield
+    finally:
+        S.RenderService._park = orig
+
+
 @dataclass(frozen=True)
 class MutationCase:
     """One seeded historical bug: the mutation, the invariant expected
@@ -1023,6 +1160,7 @@ MUTATIONS = {
     "clock-double-sample": _mut_clock_double_sample,
     "wfq-banked-credit": _mut_wfq_banked_credit,
     "defer-replay-after-park": _mut_defer_replay,
+    "park-skips-film-release": _mut_park_leak,
 }
 
 MUTATION_CASES: Tuple[MutationCase, ...] = (
@@ -1081,6 +1219,24 @@ MUTATION_CASES: Tuple[MutationCase, ...] = (
         decisions=(
             ("submit", 0), ("step",), ("step",), ("step",),
             ("preempt", "j"),
+        ),
+    ),
+    MutationCase(
+        name="park-skips-film-release",
+        historical=(
+            "serve park path: the preempted job's film carry stayed "
+            "resident after the durable emergency checkpoint landed — "
+            "every preemption leaked one film state (the ISSUE-18 "
+            "HBM-liveness suspect hbmcheck gates)"
+        ),
+        expect="PROTO-HBM",
+        scenario=Scenario(
+            name="mut-hbm",
+            jobs=(JobSpec("j", n_chunks=4, checkpoint_every=2, depth=2),),
+            allow=("submit", "step", "preempt"),
+        ),
+        decisions=(
+            ("submit", 0), ("step",), ("step",), ("preempt", "j"),
         ),
     ),
 )

@@ -209,9 +209,14 @@ class Config:
         #: quantum — the preemption granularity; None = platform chunk)
         self.serve_chunk: Optional[int] = _int("TPU_PBRT_SERVE_CHUNK", None)
         #: render-service resident-scene HBM budget in MB (LRU eviction
-        #: above it; None = unbounded)
+        #: above it; None = unbounded). The default is a checked
+        #: consequence of hbmcheck's serve HBM model (HC-CAP): the
+        #: largest 1024-aligned budget that, together with the
+        #: worst-case job load, fits the smallest platform's HBM with
+        #: headroom — `python -m tpu_pbrt.analysis.hbmcheck
+        #: --derive-hbm-caps` reproduces it
         self.serve_resident_mb: Optional[float] = _float(
-            "TPU_PBRT_SERVE_RESIDENT_MB", None
+            "TPU_PBRT_SERVE_RESIDENT_MB", 12288.0
         )
         #: pre-render stream-capacity audit (overflows fail loudly)
         self.audit_drops: bool = _flag("TPU_PBRT_AUDIT_DROPS", True)

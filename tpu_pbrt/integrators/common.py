@@ -267,6 +267,19 @@ def redispatch_backoff(chunk: int, attempt: int) -> float:
     return b * (0.5 + 0.5 * frac)
 
 
+def live_film_carries(depth: int) -> int:
+    """Worst-case simultaneously-LIVE film accumulator buffers for one
+    job dispatching through a depth-N window — the shared term of
+    hbmcheck's static HBM model (HC-CAP/HC-ALIAS) and protocheck's
+    PROTO-HBM dynamic watermark. Depth 1 compiles donation into the
+    chunk closure: input and output alias, ONE buffer. Depth > 1
+    compiles donation OUT (a deferred checkpoint snapshot may still
+    read a superseded carry), so each of the ``depth`` in-flight slices
+    pins its un-donated input carry, plus the newest output: depth + 1."""
+    d = max(1, int(depth))
+    return 1 if d == 1 else d + 1
+
+
 class DispatchWindow:
     """Bounded in-flight window of dispatched chunk-slices (ISSUE 13 /
     ROADMAP #2 — the refactor every other speed item inherits).

@@ -44,6 +44,12 @@ import numpy as np
 
 from tpu_pbrt.obs.metrics import METRICS
 
+#: film-accumulator bytes per pixel: FilmState rgb + weight + splat,
+#: all f32. hbmcheck's HC-ACCT cross-checks this against the LIVE
+#: FilmState layout — a new film plane that forgets to bump it would
+#: make the LRU evict on wrong numbers
+FILM_BYTES_PER_PIXEL = 4 * (3 + 1 + 3)
+
 
 def scene_hbm_bytes(scene) -> int:
     """Device-resident footprint of a compiled scene: every array leaf
@@ -60,7 +66,7 @@ def scene_hbm_bytes(scene) -> int:
             )
         total += int(nbytes)
     rx, ry = scene.film.full_resolution
-    total += rx * ry * 4 * (3 + 1 + 3)  # FilmState rgb + weight + splat
+    total += rx * ry * FILM_BYTES_PER_PIXEL
     return total
 
 

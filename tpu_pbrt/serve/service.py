@@ -191,6 +191,10 @@ class RenderJob:
     active_seconds: float = 0.0
     error: str = ""
     result: Optional[RenderResult] = None
+    #: plan.n_chunks stashed at activation — survives the terminal-path
+    #: plan release (a DONE/FAILED job drops its jit closures, which pin
+    #: scene HBM past eviction, but poll()/progress() still need totals)
+    chunks_total: int = 0
     # -- tpu-scope trace context (minted at submit) ------------------------
     #: deterministic request trace id ("t:<job_id>") every span, flight
     #: line, and histogram exemplar this job produces carries
@@ -208,9 +212,12 @@ class RenderJob:
 
     # -- derived -----------------------------------------------------------
     def progress(self) -> float:
-        if self.plan is None:
+        total = (
+            self.plan.n_chunks if self.plan is not None else self.chunks_total
+        )
+        if total <= 0:
             return 0.0
-        return self.cursor / max(self.plan.n_chunks, 1)
+        return self.cursor / total
 
     def rays_so_far(self) -> int:
         return self.prev_rays + sum(
@@ -601,6 +608,25 @@ class RenderService:
         self.clock.sleep(max(min(waiting) - now, 0.0))
         return self.scheduler.pick(self._runnable(self._now()))
 
+    def _release_device(self, job: RenderJob) -> None:
+        """Drop EVERY device reference a job holds: the film carry, the
+        in-flight window's un-donated slices, and the per-slice counter
+        scalars. The one release point the terminal paths (cancel, fail,
+        give-up, finalize) all call — hbmcheck's HC-LEAK rule checks
+        statically that no terminal-status write ships without it, and
+        protocheck's PROTO-HBM watches the live watermark return to
+        baseline. Leaves `plan` to the caller: a parked job keeps its
+        plan for resume; a terminal one must also null it (the jit
+        closures pin scene HBM past LRU eviction)."""
+        if job.window is not None:
+            job.window.flush(discard=True)  # closes in-flight spans
+            job.window = None
+        job.state = None
+        job.ray_counts.clear()
+        job.occ_counts.clear()
+        job.ctr_counts.clear()
+        job.nf_counts.clear()
+
     def _step_job(self, job: RenderJob) -> str:
         """Run the selected job's slice: activation, dispatch with the
         recovery ladder, prefetch overlap, and the job-level failure
@@ -625,10 +651,8 @@ class RenderService:
             if job.status not in _TERMINAL:
                 job.status = FAILED
                 job.error = job.error or f"{type(e).__name__}: {e}"
-            job.state = None
-            if job.window is not None:
-                job.window.flush(discard=True)  # closes in-flight spans
-                job.window = None
+            self._release_device(job)
+            job.plan = None
             self.residency.unpin(job.resident_key)
             self._update_depth_gauge()
             self._trace_job_end(job, "failed")
@@ -673,10 +697,8 @@ class RenderService:
             if nxt.status not in _TERMINAL:
                 nxt.status = FAILED
                 nxt.error = f"{type(e).__name__}: {e}"
-            nxt.state = None
-            if nxt.window is not None:
-                nxt.window.flush(discard=True)
-                nxt.window = None
+            self._release_device(nxt)
+            nxt.plan = None
             self.residency.unpin(nxt.resident_key)
             self._update_depth_gauge()
             self._trace_job_end(nxt, "failed")
@@ -741,11 +763,8 @@ class RenderService:
         if job.status in _TERMINAL:
             return
         job.status = CANCELLED
-        job.state = None
+        self._release_device(job)
         job.plan = None
-        if job.window is not None:
-            job.window.flush(discard=True)  # closes in-flight spans
-            job.window = None
         self.residency.unpin(job.resident_key)
         self.residency.evict_over_budget()
         if job.spool_ckpt:
@@ -763,7 +782,10 @@ class RenderService:
             "priority": job.priority,
             "progress": round(job.progress(), 6),
             "chunks_done": job.cursor,
-            "chunks_total": job.plan.n_chunks if job.plan else None,
+            "chunks_total": (
+                job.plan.n_chunks if job.plan
+                else (job.chunks_total or None)
+            ),
             "preemptions": job.preemptions,
             "redispatches": job.redispatches,
             "previews": job.previews,
@@ -921,6 +943,7 @@ class RenderService:
             )
             ent.fingerprints.add(job.plan.fingerprint)
             job.plan.capacity_audit()
+        job.chunks_total = job.plan.n_chunks
         if checkpoint_exists(job.checkpoint_path):
             state, cursor, rays, ctr = load_checkpoint(
                 job.checkpoint_path, job.plan.fingerprint
@@ -1112,7 +1135,9 @@ class RenderService:
                 nf_ct = 0 if nf_dev is None else int(jax.device_get(nf_dev))
                 if nf_ct:
                     if cfg.nonfinite == "raise":
-                        job.status = FAILED
+                        # only the message here: _step_job's firewall
+                        # sets FAILED and releases the device buffers
+                        # (HC-LEAK wants status+release in ONE scope)
                         job.error = (
                             f"chunk {c} deposited {nf_ct} non-finite "
                             "sample(s) (TPU_PBRT_NONFINITE=raise)"
@@ -1206,7 +1231,8 @@ class RenderService:
                 self._park(job)  # completed work survives the failure
             job.status = FAILED
             job.error = f"chunk {job.cursor} failed {job.attempt} times: {e}"
-            job.state = None
+            self._release_device(job)
+            job.plan = None
             self.residency.unpin(job.resident_key)
             self._update_depth_gauge()
             self._trace_job_end(job, "failed")
@@ -1361,7 +1387,11 @@ class RenderService:
             stats=stats,
         )
         job.status = DONE
-        job.state = None  # the film lives on in result.film_state
+        # the film lives on in result.film_state; everything else —
+        # counter scalars, the (already-None) window — drops here, and
+        # the plan with it: its jit closures pin scene HBM past eviction
+        self._release_device(job)
+        job.plan = None
         self._report_nonfinite(job, ctr_total)
         self.residency.unpin(job.resident_key)
         self.residency.evict_over_budget()
