@@ -162,6 +162,30 @@ python tools/explore.py --ci --seed 0 --nodes 40 --depth 7 \
     --trace-out "$SMOKE_DIR/explore_trace.json"
 python tools/scope.py "$SMOKE_DIR/explore_trace.json" --check
 
+# tpu-load smoke (ISSUE 19): seeded traffic scenarios replayed against
+# the REAL RenderService in accelerated virtual time — determinism
+# (byte-identical decision logs across same-seed replays), burst shed
+# fraction + per-class p99 queue waits within spec, zero health-
+# watchdog false positives on clean scenarios (required flags on the
+# storm ones), pin balance at drain, and a capacity-sweep knee. Fixed
+# seed, hard wall budget. The exported trace carries dense multi-
+# tenant traffic in virtual time; scope --check must accept it. The
+# deterministic gate report is diffed against the committed baseline
+# the way BENCH_REPORT.md diffs captures; after an INTENTIONAL
+# scheduling/policy change refresh with:
+#   python -m tpu_pbrt.load --ci --seed 7 --report LOADTEST_baseline.json
+echo "== tpu-load traffic-replay smoke (python -m tpu_pbrt.load --ci)"
+python -m tpu_pbrt.load --ci --seed 7 --budget-s 120 \
+    --report "$SMOKE_DIR/load_report.json" \
+    --trace-out "$SMOKE_DIR/load_trace.json"
+python tools/scope.py "$SMOKE_DIR/load_trace.json" --check
+if ! diff -u LOADTEST_baseline.json "$SMOKE_DIR/load_report.json"; then
+    echo "   LOADTEST_baseline.json is stale — gate outcomes moved (see"
+    echo "   diff above); refresh after an INTENTIONAL policy change:"
+    echo "   python -m tpu_pbrt.load --ci --seed 7 --report LOADTEST_baseline.json"
+    exit 1
+fi
+
 # hbm leak-mutant smoke (ISSUE 18): re-introduce the seeded park-path
 # film leak through the REAL entry point and require PROTO-HBM to flag
 # it by name. `--mutate` exits 1 ON DETECTION, so the gate inverts:

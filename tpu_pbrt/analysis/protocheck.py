@@ -104,6 +104,7 @@ SV_RULES: Dict[str, str] = {
 _CLOCK_SCOPED = (
     "tpu_pbrt/serve/service.py",
     "tpu_pbrt/serve/queue.py",
+    "tpu_pbrt/serve/residency.py",
 )
 #: (module, class) pairs clock-scoped at class granularity — the rest
 #: of the module legitimately times host work with the stdlib

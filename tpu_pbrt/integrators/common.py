@@ -36,6 +36,7 @@ from tpu_pbrt.accel.traverse import (
     bvh_intersect_p,
 )
 from tpu_pbrt.accel.wide import wide_intersect, wide_intersect_p
+from tpu_pbrt.utils.clock import WALL
 
 
 from tpu_pbrt.cameras import generate_rays
@@ -935,6 +936,14 @@ class WavefrontIntegrator:
     #: extra rays traced per camera ray inside li() (for the Mray/s meter)
     rays_per_camera_ray: float = 1.0
 
+    #: injected time source (utils/clock.py) for the redispatch backoff
+    #: window. Class-level so existing constructors stay untouched; the
+    #: load/protocheck harnesses set it to a VirtualClock per instance,
+    #: turning the recovery ladder's backoff into a virtual-time advance
+    #: instead of a wall sleep. WALL forwards to time.sleep, so unarmed
+    #: renders behave byte-identically.
+    clock = WALL
+
     def __init__(self, params, scene, options):
         self.params = params
         self.scene = scene
@@ -1824,7 +1833,7 @@ class WavefrontIntegrator:
                             "render/backoff", backoff_s * 1e6, chunk=c,
                             attempt=attempt, trace_id=rloop_tid,
                         )
-                        time.sleep(backoff_s)
+                        self.clock.sleep(backoff_s)
                     continue
                 if timed_out:
                     break

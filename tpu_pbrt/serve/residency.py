@@ -43,6 +43,7 @@ import jax
 import numpy as np
 
 from tpu_pbrt.obs.metrics import METRICS
+from tpu_pbrt.utils.clock import WALL
 
 #: film-accumulator bytes per pixel: FilmState rgb + weight + splat,
 #: all f32. hbmcheck's HC-ACCT cross-checks this against the LIVE
@@ -113,8 +114,13 @@ class ResidentScene:
 class ResidencyCache:
     """LRU-by-HBM-footprint cache of ResidentScene entries."""
 
-    def __init__(self, max_bytes: Optional[int] = None):
+    def __init__(self, max_bytes: Optional[int] = None, clock=None):
         self.max_bytes = max_bytes
+        #: time source for compile-duration measurement only. The LRU
+        #: order below runs on `_clock`, the integer touch counter —
+        #: never this — so virtual-time harness runs and wall-clock
+        #: serving evict in the same order.
+        self.clock = clock if clock is not None else WALL
         self._entries: Dict[str, ResidentScene] = {}
         self._clock = 0
         self.scene_compiles = 0
@@ -148,9 +154,7 @@ class ResidencyCache:
             ).inc()
             self._touch(ent)
             return ent
-        import time
-
-        t0 = time.time()
+        t0 = self.clock.monotonic()
         scene, integ = builder()
         self.scene_compiles += 1
         METRICS.counter(
@@ -160,7 +164,7 @@ class ResidencyCache:
         ent = ResidentScene(
             key=key, scene=scene, integrator=integ,
             hbm_bytes=scene_hbm_bytes(scene),
-            compile_seconds=time.time() - t0,
+            compile_seconds=self.clock.monotonic() - t0,
         )
         self._entries[key] = ent
         self._touch(ent)

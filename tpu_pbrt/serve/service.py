@@ -294,7 +294,9 @@ class RenderService:
         self.chunk = chunk
         if max_resident_bytes is None and cfg.serve_resident_mb is not None:
             max_resident_bytes = int(cfg.serve_resident_mb * 1e6)
-        self.residency = ResidencyCache(max_bytes=max_resident_bytes)
+        self.residency = ResidencyCache(
+            max_bytes=max_resident_bytes, clock=self.clock
+        )
         self.scheduler = FairScheduler(seed=seed)
         self.max_active = max_active
         self.quiet = quiet
@@ -1399,5 +1401,5 @@ class RenderService:
             delete_checkpoint(job.checkpoint_path)
         self._update_depth_gauge()
         self._trace_job_end(job, "done")
-        self._flight(job, "serve_done", rays=rays,
+        self._flight(job, "serve_done", rays=rays, chunks=job.cursor,
                      seconds=round(job.active_seconds, 3))
