@@ -1,0 +1,284 @@
+"""tpu-fleet tests (ISSUE 20): consistent-hash affinity, edge
+admission, double-delivery dedup, kill/drain failover through the
+durable spool, router-restart adoption, and the multi-replica load
+replay's byte-determinism. Jobs are protocheck's stub (scene,
+integrator) pairs — instant, bit-deterministic, and exercising the
+same submit path the fleet selftest drives with real renders."""
+
+import numpy as np
+import pytest
+
+from tpu_pbrt.analysis.protocheck import _harness
+from tpu_pbrt.fleet.router import (
+    KNEE_REQ_S,
+    FleetPolicy,
+    FleetRouter,
+    LocalReplica,
+    fleet_size,
+)
+from tpu_pbrt.serve.service import DONE, ShedError
+from tpu_pbrt.utils.clock import VirtualClock
+
+
+def _stub(chunks=2, depth=1):
+    h = _harness()
+    return (h["StubScene"](), h["StubIntegrator"](chunks, depth))
+
+
+def _rig(tmp_path, n=2, policy=None):
+    clock = VirtualClock(start=0.0, tick=1e-6)
+    reps = [
+        LocalReplica(
+            f"r{k}", clock=clock, spool_dir=str(tmp_path / f"r{k}"),
+        )
+        for k in range(n)
+    ]
+    router = FleetRouter(
+        reps, clock=clock, policy=policy,
+        spool_dir=str(tmp_path / "fleet"),
+    )
+    return clock, reps, router
+
+
+# --------------------------------------------------------------------------
+# Ring
+# --------------------------------------------------------------------------
+
+
+def test_ring_is_a_pure_function_of_the_replica_ids(tmp_path):
+    _, _, a = _rig(tmp_path / "a", n=3)
+    _, _, b = _rig(tmp_path / "b", n=3)
+    keys = [f"scene{i}" for i in range(64)]
+    assert [a.route_key(k) for k in keys] == [
+        b.route_key(k) for k in keys
+    ]
+
+
+def test_replica_loss_moves_only_its_own_keys(tmp_path):
+    _, reps, router = _rig(tmp_path, n=3)
+    keys = [f"scene{i}" for i in range(64)]
+    before = {k: router.route_key(k) for k in keys}
+    assert len(set(before.values())) > 1  # the ring actually spreads
+    reps[1].draining = True
+    for k in keys:
+        after = router.route_key(k)
+        if before[k] == "r1":
+            assert after != "r1"
+        else:
+            assert after == before[k]  # untouched keys keep affinity
+
+
+def test_fleet_size_formula():
+    assert fleet_size(0.0) == 1
+    assert fleet_size(KNEE_REQ_S) == 1
+    assert fleet_size(KNEE_REQ_S + 0.1) == 2
+    assert fleet_size(10 * KNEE_REQ_S) == 10
+
+
+# --------------------------------------------------------------------------
+# Submit: affinity, dedup, edge admission
+# --------------------------------------------------------------------------
+
+
+def test_same_scene_routes_to_the_same_replica(tmp_path):
+    _, reps, router = _rig(tmp_path)
+    j1 = router.submit(
+        compiled=_stub(), resident_key="sceneA", job_id="ja",
+    )
+    j2 = router.submit(
+        compiled=_stub(), resident_key="sceneA", job_id="jb",
+    )
+    assert router.owner(j1) == router.owner(j2)
+    router.drain_fleet()
+    assert router.poll(j1)["status"] == DONE
+    assert router.poll(j2)["status"] == DONE
+
+
+def test_double_delivery_returns_existing_assignment(tmp_path):
+    _, reps, router = _rig(tmp_path)
+    router.submit(compiled=_stub(), resident_key="sceneA", job_id="ja")
+    again = router.submit(
+        compiled=_stub(), resident_key="sceneA", job_id="ja",
+    )
+    assert again == "ja"
+    # exactly ONE instance exists across the whole fleet
+    assert sum(len(r.service.jobs) for r in reps) == 1
+    router.drain_fleet()
+    # terminal ids stay refused inside the dedup window too
+    assert router.poll("ja")["status"] == DONE
+    assert (
+        router.submit(
+            compiled=_stub(), resident_key="sceneA", job_id="ja",
+        )
+        == "ja"
+    )
+    assert sum(len(r.service.jobs) for r in reps) == 1
+
+
+def test_edge_sheds_over_knee_and_recovers_as_the_window_slides(tmp_path):
+    clock, _, router = _rig(
+        tmp_path, n=2,
+        policy=FleetPolicy(knee_req_s=1.0, rate_window_s=1.0),
+    )
+    admitted, shed = 0, 0
+    for i in range(4):  # capacity = 1 req/s x 2 replicas over 1 s
+        try:
+            router.submit(
+                compiled=_stub(), resident_key=f"s{i}", job_id=f"e{i}",
+            )
+            admitted += 1
+        except ShedError as e:
+            assert "fleet-edge" in e.reason
+            shed += 1
+    assert (admitted, shed) == (2, 2)
+    assert router.edge_sheds == 2
+    clock.advance(1.5)  # the burst leaves the window
+    router.submit(compiled=_stub(), resident_key="s9", job_id="e9")
+    router.drain_fleet()
+
+
+# --------------------------------------------------------------------------
+# Failover
+# --------------------------------------------------------------------------
+
+
+def test_kill_failover_resumes_from_the_spool(tmp_path):
+    _, reps, router = _rig(tmp_path)
+    j = router.submit(
+        compiled=_stub(chunks=4), resident_key="sceneK", job_id="jk",
+        checkpoint_every=1,
+    )
+    victim = router.owner(j)
+    survivor = "r1" if victim == "r0" else "r0"
+    while router.poll(j)["chunks_done"] < 2:
+        assert router.step() is not None
+    at_kill = router.poll(j)["chunks_done"]
+    assert router.kill_replica(victim) == [j]
+    assert router.owner(j) == survivor
+    router.drain_fleet()
+    p = router.poll(j)
+    assert p["status"] == DONE
+    assert p["failovers"] == 1
+    # resumed, not restarted: the survivor's instance began at the
+    # durable cursor, and the terminal film is bit-identical to the
+    # sequential reference schedule
+    res = router.replicas[survivor].service.jobs[j].result
+    ref = _harness()["reference_state"](4)
+    assert np.array_equal(
+        np.asarray(res.film_state.rgb), np.asarray(ref.rgb)
+    )
+    assert at_kill >= 2
+
+
+def test_drain_failover_cancels_the_old_instance(tmp_path):
+    _, reps, router = _rig(tmp_path)
+    j = router.submit(
+        compiled=_stub(chunks=4), resident_key="sceneD", job_id="jd",
+        checkpoint_every=1,
+    )
+    old = router.owner(j)
+    new = "r1" if old == "r0" else "r0"
+    router.step()
+    assert router.drain_replica(old) == [j]
+    assert router.owner(j) == new
+    # consume-the-spool dedup: the drained replica's instance is
+    # terminal, so only ONE live instance exists fleet-wide
+    assert router.replicas[old].status(j) == "cancelled"
+    router.drain_fleet()
+    assert router.poll(j)["status"] == DONE
+
+
+# --------------------------------------------------------------------------
+# Router restart
+# --------------------------------------------------------------------------
+
+
+def test_adopt_rebuilds_the_table_and_loses_no_job(tmp_path):
+    clock, reps, router = _rig(tmp_path)
+    j = router.submit(
+        compiled=_stub(chunks=3), resident_key="sceneR", job_id="jr",
+        checkpoint_every=1,
+    )
+    router.step()
+    router2 = FleetRouter.adopt(
+        reps, clock=clock, spool_dir=str(tmp_path / "fleet"),
+    )
+    assert "jr" in router2.jobs
+    assert router2.owner("jr") == router.owner("jr")
+    router2.drain_fleet()
+    assert router2.poll("jr")["status"] == DONE
+
+
+def test_adopted_jobs_cannot_fail_over_but_are_not_lost(tmp_path):
+    clock, reps, router = _rig(tmp_path)
+    router.submit(
+        compiled=_stub(chunks=4), resident_key="sceneR", job_id="jr",
+        checkpoint_every=1,
+    )
+    router.step()
+    router2 = FleetRouter.adopt(
+        reps, clock=clock, spool_dir=str(tmp_path / "fleet"),
+    )
+    with pytest.raises(RuntimeError, match="submit source"):
+        router2._failover_job("jr", router2.owner("jr"))
+    router2.drain_fleet()
+    assert router2.poll("jr")["status"] == DONE
+
+
+# --------------------------------------------------------------------------
+# Multi-replica load replay
+# --------------------------------------------------------------------------
+
+
+def test_fleet_replay_is_byte_deterministic_and_spreads():
+    from tpu_pbrt.load.replay import replay
+    from tpu_pbrt.load.workload import SCENARIOS, generate
+
+    wl = generate(SCENARIOS["editstorm"].spec, 7)
+    a = replay(wl, replicas=2)
+    b = replay(wl, replicas=2)
+    assert a.log_text() == b.log_text()
+    owners = {
+        ln.rsplit("@", 1)[1] for ln in a.log if "-> ok@" in ln
+    }
+    assert owners == {"r0", "r1"}  # the editstorm key set splits
+    assert a.failed == 0 and not a.unfinished
+    assert a.completed == a.submitted
+    assert not a.pin_leaks
+
+
+def test_fleet_replay_single_replica_path_untouched():
+    from tpu_pbrt.load.replay import replay
+    from tpu_pbrt.load.workload import SCENARIOS, generate
+
+    wl = generate(SCENARIOS["steady"].spec, 7)
+    assert replay(wl).log_text() == replay(wl, replicas=1).log_text()
+
+
+# --------------------------------------------------------------------------
+# Daemon replica (process spawn + jax import: not tier-1)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_daemon_replica_roundtrip(tmp_path):
+    import time
+
+    from tpu_pbrt.fleet.daemon import DaemonReplica
+    from tpu_pbrt.scenes import cornell_box_text
+
+    text = cornell_box_text(res=16, spp=1, integrator="path", maxdepth=2)
+    rep = DaemonReplica("d0", spool_dir=str(tmp_path / "d0"), chunk=256)
+    try:
+        job = rep.submit(text=text, job_id="dj", trace_id="t:dj")
+        deadline = time.monotonic() + 240
+        while rep.status(job) not in ("done", "failed", None):
+            assert time.monotonic() < deadline, "daemon job timed out"
+            time.sleep(0.2)
+        assert rep.status(job) == "done"
+        ans = rep.drain()
+        assert ans["ok"] and ans["draining"] and ans["quiescent"]
+        assert rep.shutdown() == 0
+    finally:
+        if rep.proc.poll() is None:
+            rep.proc.kill()

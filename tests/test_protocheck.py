@@ -238,6 +238,7 @@ class TestMutationCorpus:
     def test_corpus_covers_the_seeded_bugs(self):
         assert {c.expect for c in pc.MUTATION_CASES} == {
             "PROTO-WEDGE", "PROTO-VTIME", "PROTO-DEFER", "PROTO-HBM",
+            "PROTO-ROUTE-DUP",
         }
 
 

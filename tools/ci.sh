@@ -1,11 +1,12 @@
 #!/usr/bin/env bash
-# Local CI gate (ISSUE 2 + 3 + 11 + 15 + 17 + 18):
+# Local CI gate (ISSUE 2 + 3 + 11 + 15 + 17 + 18 + 19 + 20):
 #   ruff -> jaxlint (AST) -> jaxpr audit + jaxcost budget gate + shardcheck
 #   + pallascheck VMEM/grid-semantics gate + protocheck protocol lint
 #   + hbmcheck HBM residency/liveness/capacity gate
 #   -> telemetry/chaos/serve smokes
 #   -> tpu-scope (timeline reconstruction + health verb + bench gate)
 #   -> protocheck explorer smoke (bounded interleaving/fault search)
+#   -> tpu-load traffic replay + fleet router smokes (baseline-diffed)
 #   -> tier-1 pytest.
 #
 #   tools/ci.sh            # full gate
@@ -99,7 +100,9 @@ TPU_PBRT_PIPELINE=2 python -m tpu_pbrt.chaos --only pipeline
 # chaos recovery matrix (ISSUE 5): every fault scenario — poisoned/clean
 # dispatch loss, torn/crashed/bit-flipped checkpoint writes, corrupt
 # checkpoint resume, NaN wave, retry-budget exhaustion, mesh device
-# loss — must recover to a film BIT-identical to the undisturbed render
+# loss, plus the ISSUE 20 fleet rows (replica killed mid-job resumes
+# elsewhere from the spool; a restarted router adopts the replicas)
+# — must recover to a film BIT-identical to the undisturbed render
 # (the nan-wave-scrub row instead gates the degrade semantics: finite
 # image + nonfinite_deposits>0). Runs on CPU; no accelerator needed.
 # TPU_PBRT_PIPELINE=2 is the default, exported explicitly so the gate
@@ -183,6 +186,49 @@ if ! diff -u LOADTEST_baseline.json "$SMOKE_DIR/load_report.json"; then
     echo "   LOADTEST_baseline.json is stale — gate outcomes moved (see"
     echo "   diff above); refresh after an INTENTIONAL policy change:"
     echo "   python -m tpu_pbrt.load --ci --seed 7 --report LOADTEST_baseline.json"
+    exit 1
+fi
+
+# tpu-fleet stage (ISSUE 20): replicated serve behind the failover
+# router. (1) the fleet selftest — two REAL in-process replicas under
+# one VirtualClock: scene-affinity routing with a residency warm hit,
+# fleet-edge shedding at a clamped knee, and a kill-one failover whose
+# resumed film is BIT-identical to the undisturbed solo render — with
+# tracing armed so (2) scope --check validates the cross-replica
+# timeline (router-owned root spans spanning the re-route). (3) the
+# seeded router mutant: a failover that re-submits WITHOUT consuming
+# the old instance must be flagged by PROTO-ROUTE-DUP by name
+# (--mutate exits 1 on detection, so the gate inverts). (4) the
+# multi-replica load smoke: the same seeded workloads replayed through
+# the router at --replicas 2, decision logs byte-deterministic per
+# (spec, seed, N), gates evaluated fleet-wide, report diffed against
+# the committed baseline; after an INTENTIONAL routing/policy change:
+#   python -m tpu_pbrt.load --scenario steady --scenario heavy \
+#     --scenario editstorm --replicas 2 --seed 7 --report FLEET_baseline.json
+echo "== fleet router smoke, tracing-armed (python -m tpu_pbrt.fleet --selftest)"
+XLA_FLAGS="${XLA_FLAGS:-} --xla_backend_optimization_level=0" \
+TPU_PBRT_TRACE_PATH="$SMOKE_DIR/fleet_trace.json" \
+python -m tpu_pbrt.fleet --selftest
+python tools/scope.py "$SMOKE_DIR/fleet_trace.json" --check
+echo "== fleet failover-dedup mutant (python tools/explore.py --mutate failover-skips-spool-consume)"
+if python tools/explore.py --mutate failover-skips-spool-consume > "$SMOKE_DIR/fleet_mutant.log" 2>&1; then
+    echo "   seeded failover-dedup mutant NOT detected — PROTO-ROUTE-DUP gate rotted"
+    cat "$SMOKE_DIR/fleet_mutant.log"
+    exit 1
+fi
+grep -q "PROTOCHECK VIOLATION PROTO-ROUTE-DUP" "$SMOKE_DIR/fleet_mutant.log" || {
+    echo "   mutant flagged, but not by PROTO-ROUTE-DUP:"
+    cat "$SMOKE_DIR/fleet_mutant.log"
+    exit 1
+}
+echo "== fleet multi-replica load smoke (python -m tpu_pbrt.load --replicas 2)"
+python -m tpu_pbrt.load --scenario steady --scenario heavy \
+    --scenario editstorm --replicas 2 --seed 7 \
+    --report "$SMOKE_DIR/fleet_report.json"
+if ! diff -u FLEET_baseline.json "$SMOKE_DIR/fleet_report.json"; then
+    echo "   FLEET_baseline.json is stale — routed gate outcomes moved"
+    echo "   (see diff above); refresh after an INTENTIONAL change:"
+    echo "   python -m tpu_pbrt.load --scenario steady --scenario heavy --scenario editstorm --replicas 2 --seed 7 --report FLEET_baseline.json"
     exit 1
 fi
 

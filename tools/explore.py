@@ -73,7 +73,7 @@ class Explorer:
     def _replay(self, prefix: tuple) -> Tuple[list, tuple, List[str]]:
         """Fresh model, replay `prefix`. Returns (violations,
         fingerprint, enabled decisions)."""
-        with pc.ProtocolModel(self.scenario, seed=self.seed) as model:
+        with pc.make_model(self.scenario, seed=self.seed) as model:
             model.run(prefix)
             return (
                 list(model.violations),
@@ -111,18 +111,14 @@ def canonical_drain(
     schedulable. Returns (decisions, event log, violations) — the
     determinism gate replays the decisions and compares the logs."""
     decisions: List[tuple] = []
-    with pc.ProtocolModel(scenario, seed=seed) as model:
+    with pc.make_model(scenario, seed=seed) as model:
         for i in range(len(scenario.jobs)):
             d = ("submit", i)
             model.apply(d)
             decisions.append(d)
         for _ in range(max_steps):
-            enabled = model.enabled_decisions()
-            if ("step",) in enabled:
-                d = ("step",)
-            elif ("advance",) in enabled:
-                d = ("advance",)
-            else:
+            d = _drain_pick(model.enabled_decisions())
+            if d is None:
                 break
             model.apply(d)
             decisions.append(d)
@@ -131,10 +127,21 @@ def canonical_drain(
         return tuple(decisions), list(model.log), list(model.violations)
 
 
+def _drain_pick(enabled: List[tuple]) -> Optional[tuple]:
+    """The canonical drain's next decision: the first step — ("step",)
+    single-service, ("rstep", k) in replica order for fleet scenarios —
+    else wait out a backoff window. Kill/drain decisions are never
+    canonical (they are explored, not drained through)."""
+    d = next((x for x in enabled if x[0] in ("step", "rstep")), None)
+    if d is None:
+        d = next((x for x in enabled if x[0] == "advance"), None)
+    return d
+
+
 def replay_log(
     scenario: pc.Scenario, decisions: tuple, seed: int = 0,
 ) -> List[str]:
-    with pc.ProtocolModel(scenario, seed=seed) as model:
+    with pc.make_model(scenario, seed=seed) as model:
         model.run(decisions)
         return list(model.log)
 
@@ -151,17 +158,14 @@ def export_trace(
     TRACE.configure(path)
     TRACE.reset()
     try:
-        with pc.ProtocolModel(scenario, seed=seed) as model:
+        with pc.make_model(scenario, seed=seed) as model:
             for i in range(len(scenario.jobs)):
                 model.apply(("submit", i))
             for _ in range(400):
-                enabled = model.enabled_decisions()
-                if ("step",) in enabled:
-                    model.apply(("step",))
-                elif ("advance",) in enabled:
-                    model.apply(("advance",))
-                else:
+                d = _drain_pick(model.enabled_decisions())
+                if d is None:
                     break
+                model.apply(d)
             # export INSIDE the model context: the clock is still the
             # VirtualClock, so otherData.clock stamps "virtual"
             return TRACE.export(path)

@@ -105,6 +105,7 @@ _CLOCK_SCOPED = (
     "tpu_pbrt/serve/service.py",
     "tpu_pbrt/serve/queue.py",
     "tpu_pbrt/serve/residency.py",
+    "tpu_pbrt/fleet/router.py",
 )
 #: (module, class) pairs clock-scoped at class granularity — the rest
 #: of the module legitimately times host work with the stdlib
@@ -532,6 +533,10 @@ class JobSpec:
     n_chunks: int = 3
     checkpoint_every: int = 0
     depth: int = 1
+    #: scene-affinity routing key for fleet scenarios (defaults to the
+    #: job name; two jobs sharing a scene MUST co-locate while their
+    #: replica stays healthy — PROTO-ROUTE-AFFINITY)
+    scene: str = ""
 
 
 @dataclass(frozen=True)
@@ -544,6 +549,10 @@ class Scenario:
     jobs: Tuple[JobSpec, ...]
     fault: str = ""
     allow: Tuple[str, ...] = ("submit", "step", "advance")
+    #: >1 selects the fleet model (a FleetRouter over N LocalReplicas
+    #: under one VirtualClock) with the router decision kinds
+    #: ("rstep", k) / ("kill", k) / ("drain", k) in the grid
+    replicas: int = 1
 
 
 def smoke_scenarios(n_fault_chunks: int = 2) -> List[Scenario]:
@@ -573,6 +582,32 @@ def smoke_scenarios(n_fault_chunks: int = 2) -> List[Scenario]:
             fault=fault,
             allow=("submit", "step", "advance"),
         ))
+    # the ISSUE-20 router grid: route / re-route / resume-elsewhere /
+    # double-delivery, explored over 2 replicas under one VirtualClock
+    out.append(Scenario(
+        name="fleet-affine",
+        jobs=(
+            JobSpec("fa1", scene="sS", n_chunks=2, checkpoint_every=1),
+            JobSpec("fa2", scene="sS", n_chunks=2, checkpoint_every=1),
+        ),
+        allow=("submit", "rstep", "advance"),
+        replicas=2,
+    ))
+    out.append(Scenario(
+        name="fleet-kill",
+        jobs=(JobSpec("fk", scene="sK", n_chunks=3, checkpoint_every=1),),
+        allow=("submit", "rstep", "advance", "kill"),
+        replicas=2,
+    ))
+    out.append(Scenario(
+        name="fleet-drain",
+        jobs=(
+            JobSpec("fd1", scene="sD", n_chunks=2, checkpoint_every=1),
+            JobSpec("fd2", scene="sE", n_chunks=2, checkpoint_every=1),
+        ),
+        allow=("submit", "rstep", "advance", "drain"),
+        replicas=2,
+    ))
     return out
 
 
@@ -1046,6 +1081,415 @@ class ProtocolModel:
 
 
 # --------------------------------------------------------------------------
+# The fleet model (ISSUE 20): the router/replica handoff protocol
+# --------------------------------------------------------------------------
+
+
+class FleetModel:
+    """N real RenderServices behind a real FleetRouter, one shared
+    VirtualClock, driven by explicit decisions — the handoff protocol
+    (route / re-route / resume-elsewhere / double-delivery) as a pure
+    function of the decision sequence, with the PROTO-ROUTE-*
+    invariants checked after every one.
+
+    Decisions (tuples; same explorer contract as ProtocolModel):
+
+    - ``("submit", i)``  — submit scenario job ``i`` THROUGH the router
+    - ``("rstep", k)``   — one scheduler step on replica ``k``
+    - ``("advance",)``   — virtual time to just before the earliest
+      open backoff deadline across all alive replicas
+    - ``("kill", k)``    — abrupt replica death + spool failover
+    - ``("drain", k)``   — graceful drain + spool failover
+
+    Invariants:
+
+    - PROTO-ROUTE-AFFINITY — a submit of a seen scene key routes to
+      the same replica while that replica stays healthy
+    - PROTO-ROUTE-DUP — no job id has two live instances on alive
+      replicas, and no job is DONE on more than one replica (the
+      double-render guard the failover-skips-spool-consume mutant
+      seeds a regression for)
+    - PROTO-ROUTE-LOST — every admitted non-terminal job has exactly
+      one live instance somewhere alive; every DONE record a DONE
+      instance
+    - PROTO-ROUTE-PIN — residency pins balance live holders on every
+      alive replica (ProtocolModel's PROTO-PIN, per replica)
+    - PROTO-ROUTE-FILM — every DONE film is bit-identical to the
+      sequential single-replica schedule's, rays exactly
+      ``n_chunks x RAYS_PER_CHUNK`` (failover resumes from the durable
+      cursor, never re-accumulates)
+
+    PROTO-DEFER rides along via the checkpoint write observer: the
+    durable cursor at one router-owned spool path must stay monotone
+    ACROSS replicas — a failover that re-renders retired chunks would
+    regress it.
+    """
+
+    EPS = 1e-6
+
+    def __init__(self, scenario: Scenario, seed: int = 0):
+        import tempfile
+
+        from tpu_pbrt.chaos import CHAOS
+        from tpu_pbrt.fleet.router import FleetRouter, LocalReplica
+        from tpu_pbrt.obs.flight import FLIGHT
+        from tpu_pbrt.obs.trace import TRACE
+        from tpu_pbrt.parallel import checkpoint as ckpt
+        from tpu_pbrt.utils.clock import VirtualClock
+
+        self.scenario = scenario
+        self.seed = int(seed)
+        self.clock = VirtualClock(start=0.0, tick=self.EPS)
+        self.tmpdir = tempfile.mkdtemp(prefix="protocheck_fleet_")
+        self._rids = [f"r{k}" for k in range(int(scenario.replicas))]
+        replicas = [
+            LocalReplica(
+                rid, clock=self.clock, seed=self.seed,
+                spool_dir=os.path.join(self.tmpdir, rid),
+            )
+            for rid in self._rids
+        ]
+        self.router = FleetRouter(
+            replicas, clock=self.clock,
+            spool_dir=os.path.join(self.tmpdir, "fleet"),
+        )
+        CHAOS.install(scenario.fault, self.seed)
+        self._ckpt = ckpt
+        self._watermark: Dict[str, int] = {}
+        self.ckpt_writes = 0
+        self.violations: List[Tuple[str, str]] = []
+        self.log: List[str] = []
+        self._unsubmitted = set(range(len(scenario.jobs)))
+        self._done_checked: set = set()
+        #: the model's own affinity expectation: scene key -> the
+        #: replica the router last placed it on
+        self._affinity: Dict[str, str] = {}
+        self._obs = self._on_ckpt_write
+        ckpt.register_write_observer(self._obs)
+        self._flight_prev = (FLIGHT._clock, FLIGHT._t0)
+        FLIGHT.set_clock(self.clock)
+        self._trace_prev = (TRACE._clock, TRACE._t0)
+        TRACE.set_clock(self.clock)
+        self.closed = False
+
+    def _on_ckpt_write(self, path: str, cursor: int, rays: int) -> None:
+        """PROTO-DEFER across the fleet: one durable path, one monotone
+        cursor — no matter WHICH replica writes it."""
+        self.ckpt_writes += 1
+        prev = self._watermark.get(path)
+        if prev is not None and cursor < prev:
+            self.violations.append((
+                "PROTO-DEFER",
+                f"durable cursor regressed {prev} -> {cursor} at one "
+                f"spool path across the fleet (write #{self.ckpt_writes})"
+                f" — a failover re-rendered already-durable chunks",
+            ))
+        self._watermark[path] = max(prev or 0, int(cursor))
+
+    # -- decisions ---------------------------------------------------------
+    def _key(self, spec: JobSpec) -> str:
+        return f"stub:{spec.scene or spec.name}"
+
+    def enabled_decisions(self) -> List[tuple]:
+        from tpu_pbrt.serve.service import PAUSED, _RUNNABLE, _TERMINAL
+
+        allow = self.scenario.allow
+        healthy = self.router.healthy()
+        out: List[tuple] = []
+        if "submit" in allow and healthy:
+            out.extend(("submit", i) for i in sorted(self._unsubmitted))
+        now = self.clock.peek()
+        any_backoff = False
+        for k, rid in enumerate(self._rids):
+            r = self.router.replicas[rid]
+            if not r.alive:
+                continue
+            jobs = list(r.service.jobs.values())
+            live = [j for j in jobs if j.status not in _TERMINAL]
+            if "rstep" in allow and any(j.status != PAUSED for j in live):
+                out.append(("rstep", k))
+            any_backoff = any_backoff or any(
+                j.status in _RUNNABLE and j.not_before > now for j in jobs
+            )
+        if "advance" in allow and any_backoff:
+            out.append(("advance",))
+        # eviction decisions keep at least one healthy survivor — a
+        # fleet with nowhere left to route is outside the protocol
+        for k, rid in enumerate(self._rids):
+            r = self.router.replicas[rid]
+            survivors = [h for h in healthy if h != rid]
+            if "kill" in allow and r.alive and survivors:
+                out.append(("kill", k))
+            if "drain" in allow and r.alive and not r.draining and survivors:
+                out.append(("drain", k))
+        return out
+
+    def apply(self, decision: tuple) -> str:
+        from tpu_pbrt.serve.service import _RUNNABLE
+
+        kind = decision[0]
+        outcome = ""
+        try:
+            if kind == "submit":
+                i = int(decision[1])
+                spec = self.scenario.jobs[i]
+                self._unsubmitted.discard(i)
+                h = _harness()
+                key = self._key(spec)
+                expected = self._affinity.get(key)
+                healthy_before = set(self.router.healthy())
+                self.router.submit(
+                    compiled=(h["StubScene"](),
+                              h["StubIntegrator"](spec.n_chunks, spec.depth)),
+                    resident_key=key, job_id=spec.name,
+                    tenant=spec.tenant, priority=spec.priority,
+                    checkpoint_every=spec.checkpoint_every,
+                )
+                rid = self.router.jobs[spec.name].rid
+                if (
+                    expected is not None
+                    and expected in healthy_before
+                    and rid != expected
+                ):
+                    self.violations.append((
+                        "PROTO-ROUTE-AFFINITY",
+                        f"scene key {key!r} routed to {rid}, but its "
+                        f"compiled scene is resident on the still-"
+                        f"healthy {expected} — the warm path lost",
+                    ))
+                self._affinity[key] = rid
+                outcome = f"submitted:{spec.name}@{rid}"
+            elif kind == "rstep":
+                rid = self._rids[int(decision[1])]
+                job = self.router.step_replica(rid)
+                outcome = f"{rid}/{job}" if job is not None else f"{rid}/idle"
+            elif kind == "advance":
+                now = self.clock.peek()
+                deadlines = [
+                    j.not_before
+                    for rid in self._rids
+                    if self.router.replicas[rid].alive
+                    for j in self.router.replicas[rid].service.jobs.values()
+                    if j.status in _RUNNABLE and j.not_before > now
+                ]
+                if deadlines:
+                    target = min(deadlines) - self.EPS / 2
+                    self.clock.advance_to(target)
+                    outcome = f"advanced:{target:.6f}"
+                else:
+                    outcome = "noop"
+            elif kind in ("kill", "drain"):
+                rid = self._rids[int(decision[1])]
+                if kind == "kill":
+                    moved = self.router.kill_replica(rid)
+                else:
+                    moved = self.router.drain_replica(rid)
+                for job_id in moved:
+                    rec = self.router.jobs[job_id]
+                    self._affinity[rec.key] = rec.rid
+                outcome = f"{kind}ed:{rid}+moved:{','.join(moved) or '-'}"
+            else:
+                raise ValueError(f"unknown decision kind {kind!r}")
+        except Exception as e:  # noqa: BLE001 — a crash IS a finding
+            detail = str(e).replace(self.tmpdir, "<spool>")
+            self.violations.append((
+                "PROTO-CRASH",
+                f"decision {decision} raised {type(e).__name__}: {detail}",
+            ))
+            outcome = f"crash:{type(e).__name__}"
+        self._check_invariants(decision)
+        self._log_line(decision, outcome)
+        return outcome
+
+    def run(self, decisions) -> "FleetModel":
+        for d in decisions:
+            self.apply(tuple(d))
+        return self
+
+    # -- invariants ---------------------------------------------------------
+    def _check_invariants(self, decision: tuple) -> None:
+        import numpy as np
+
+        from tpu_pbrt.serve.service import DONE, _TERMINAL
+
+        router = self.router
+        # instance census per admitted job: DUP / LOST
+        for job_id, rec in router.jobs.items():
+            live_on: List[str] = []
+            done_on: List[str] = []
+            for rid in self._rids:
+                r = router.replicas[rid]
+                j = r.service.jobs.get(job_id)
+                if j is None:
+                    continue
+                if j.status == DONE:
+                    done_on.append(rid)
+                if r.alive and j.status not in _TERMINAL:
+                    live_on.append(rid)
+            if len(live_on) > 1:
+                self.violations.append((
+                    "PROTO-ROUTE-DUP",
+                    f"job {job_id} is live on {live_on} simultaneously "
+                    f"after {decision!r} — a failover delivered the job "
+                    f"without consuming the previous instance",
+                ))
+            if len(done_on) > 1:
+                self.violations.append((
+                    "PROTO-ROUTE-DUP",
+                    f"job {job_id} rendered to DONE on {done_on} — the "
+                    f"same request paid for twice",
+                ))
+            if not rec.terminal and not live_on:
+                self.violations.append((
+                    "PROTO-ROUTE-LOST",
+                    f"admitted job {job_id} has no live instance on any "
+                    f"alive replica after {decision!r} — lost across a "
+                    f"failover",
+                ))
+            if rec.terminal == DONE and not done_on:
+                self.violations.append((
+                    "PROTO-ROUTE-LOST",
+                    f"job {job_id} recorded DONE at the router but no "
+                    f"replica holds its result",
+                ))
+        # PROTO-ROUTE-PIN: ProtocolModel's pin balance, per alive replica
+        for rid in self._rids:
+            r = router.replicas[rid]
+            if not r.alive:
+                continue
+            pins = r.service.residency.pin_counts()
+            expected: Dict[str, int] = {}
+            for j in r.service.jobs.values():
+                if j.status not in _TERMINAL:
+                    expected[j.resident_key] = (
+                        expected.get(j.resident_key, 0) + 1
+                    )
+            for key in sorted(set(pins) | set(expected)):
+                if pins.get(key, 0) != expected.get(key, 0):
+                    self.violations.append((
+                        "PROTO-ROUTE-PIN",
+                        f"replica {rid} pin imbalance for {key!r}: "
+                        f"{pins.get(key, 0)} pin(s) vs "
+                        f"{expected.get(key, 0)} live holder(s)",
+                    ))
+        # PROTO-ROUTE-FILM at each fleet-terminal DONE
+        for job_id, rec in router.jobs.items():
+            if rec.terminal != DONE or job_id in self._done_checked:
+                continue
+            self._done_checked.add(job_id)
+            spec = next(
+                s for s in self.scenario.jobs if s.name == job_id
+            )
+            owner = router.replicas.get(rec.rid)
+            j = None if owner is None else owner.service.jobs.get(job_id)
+            res = None if j is None else j.result
+            want = spec.n_chunks * RAYS_PER_CHUNK
+            if res is None or int(res.rays_traced) != want:
+                got = None if res is None else int(res.rays_traced)
+                self.violations.append((
+                    "PROTO-ROUTE-FILM",
+                    f"job {job_id} finished with rays_traced={got}, "
+                    f"expected {want} — chunks lost or re-accumulated "
+                    f"across the failover resume",
+                ))
+                continue
+            ref = _harness()["reference_state"](spec.n_chunks)
+            fs = res.film_state
+            if not (
+                np.array_equal(np.asarray(fs.rgb), np.asarray(ref.rgb))
+                and np.array_equal(
+                    np.asarray(fs.weight), np.asarray(ref.weight)
+                )
+            ):
+                self.violations.append((
+                    "PROTO-ROUTE-FILM",
+                    f"job {job_id} terminal film differs bitwise from "
+                    f"the single-replica sequential schedule's — the "
+                    f"re-route/resume changed the accumulation",
+                ))
+
+    # -- artifacts ----------------------------------------------------------
+    def _log_line(self, decision: tuple, outcome: str) -> None:
+        parts = []
+        for rid in self._rids:
+            r = self.router.replicas[rid]
+            flag = ("" if r.alive else "!") + ("~" if r.draining else "")
+            jobs = " ".join(
+                f"{j.job_id}:{j.status}:c{j.cursor}:a{j.attempt}"
+                f":nb{j.not_before:.6f}"
+                for j in sorted(
+                    r.service.jobs.values(), key=lambda j: j.job_id
+                )
+            )
+            parts.append(f"{flag}{rid}[{jobs}]")
+        self.log.append(
+            f"{len(self.log):03d} {decision!r} -> {outcome} "
+            f"@{self.clock.peek():.6f} | {' '.join(parts)} | "
+            f"routes={len(self.router.routes)} "
+            f"sheds={self.router.edge_sheds} ckpt={self.ckpt_writes}"
+        )
+
+    def fingerprint(self) -> tuple:
+        now = self.clock.peek()
+        reps = tuple(
+            (
+                rid, r.alive, r.draining,
+                tuple(
+                    (
+                        j.job_id, j.status, j.cursor, j.attempt,
+                        j.state is None,
+                        round(max(j.not_before - now, 0.0), 9),
+                    )
+                    for j in sorted(
+                        r.service.jobs.values(), key=lambda j: j.job_id
+                    )
+                ),
+            )
+            for rid in self._rids
+            for r in (self.router.replicas[rid],)
+        )
+        recs = tuple(
+            (
+                job_id, rec.rid, rec.terminal, rec.failovers,
+                self._ckpt.checkpoint_exists(rec.checkpoint_path),
+            )
+            for job_id, rec in sorted(self.router.jobs.items())
+        )
+        return (reps, recs, tuple(sorted(self._unsubmitted)))
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        import shutil
+
+        from tpu_pbrt.chaos import CHAOS
+        from tpu_pbrt.obs.flight import FLIGHT
+        from tpu_pbrt.obs.trace import TRACE
+
+        CHAOS.clear()
+        self._ckpt.unregister_write_observer(self._obs)
+        FLIGHT._clock, FLIGHT._t0 = self._flight_prev
+        TRACE._clock, TRACE._t0 = self._trace_prev
+        shutil.rmtree(self.tmpdir, ignore_errors=True)
+
+    def __enter__(self) -> "FleetModel":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def make_model(scenario: Scenario, seed: int = 0):
+    """The explorer's model factory: one scenario, one model — the
+    fleet shape when the scenario asks for replicas, the single-service
+    ProtocolModel otherwise (byte-identical to the pre-fleet grid)."""
+    if int(getattr(scenario, "replicas", 1)) > 1:
+        return FleetModel(scenario, seed=seed)
+    return ProtocolModel(scenario, seed=seed)
+
+
+# --------------------------------------------------------------------------
 # Mutation-regression corpus
 # --------------------------------------------------------------------------
 
@@ -1144,6 +1588,30 @@ def _mut_park_leak():
         S.RenderService._park = orig
 
 
+@contextmanager
+def _mut_failover_skip_consume():
+    """Seeded ISSUE-20 fleet bug: the failover path re-submits the job
+    on the surviving replica WITHOUT consuming the old instance first
+    (no cancel on the drained-but-alive source). Both replicas now
+    consider the job theirs — the drained one holds it PAUSED with a
+    durable spool entry, the survivor renders it again from that same
+    spool: a double delivery, and a double render once the drain
+    lifts. PROTO-ROUTE-DUP's live-instance census flags it at the
+    drain decision."""
+    from tpu_pbrt.fleet import router as R
+
+    orig = R.FleetRouter._failover_job
+
+    def _failover_job(self, job_id, from_rid, *, cancel_old=True):
+        return orig(self, job_id, from_rid, cancel_old=False)
+
+    R.FleetRouter._failover_job = _failover_job
+    try:
+        yield
+    finally:
+        R.FleetRouter._failover_job = orig
+
+
 @dataclass(frozen=True)
 class MutationCase:
     """One seeded historical bug: the mutation, the invariant expected
@@ -1162,6 +1630,7 @@ MUTATIONS = {
     "wfq-banked-credit": _mut_wfq_banked_credit,
     "defer-replay-after-park": _mut_defer_replay,
     "park-skips-film-release": _mut_park_leak,
+    "failover-skips-spool-consume": _mut_failover_skip_consume,
 }
 
 MUTATION_CASES: Tuple[MutationCase, ...] = (
@@ -1240,6 +1709,29 @@ MUTATION_CASES: Tuple[MutationCase, ...] = (
             ("submit", 0), ("step",), ("step",), ("preempt", "j"),
         ),
     ),
+    MutationCase(
+        name="failover-skips-spool-consume",
+        historical=(
+            "ISSUE-20 fleet failover: the drain path re-submitted a "
+            "job on the surviving replica without consuming the old "
+            "instance — both replicas rendered it (double delivery, "
+            "double spend)"
+        ),
+        expect="PROTO-ROUTE-DUP",
+        scenario=Scenario(
+            name="mut-route",
+            # key "stub:sJ" hashes to r0 on the 2-replica ring — the
+            # drain target below is hand-verified like every corpus
+            # decision sequence
+            jobs=(JobSpec("j", scene="sJ", n_chunks=4,
+                          checkpoint_every=2),),
+            allow=("submit", "rstep", "advance", "drain"),
+            replicas=2,
+        ),
+        decisions=(
+            ("submit", 0), ("rstep", 0), ("rstep", 0), ("drain", 0),
+        ),
+    ),
 )
 
 
@@ -1264,7 +1756,7 @@ def run_mutation_case(
     case = mutation_case(name)
     ctx = MUTATIONS[case.name]() if mutate else _null_ctx()
     with ctx:
-        with ProtocolModel(case.scenario, seed=seed) as model:
+        with make_model(case.scenario, seed=seed) as model:
             model.run(case.decisions)
             return list(model.violations), list(model.log)
 

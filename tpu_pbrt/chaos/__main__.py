@@ -17,6 +17,12 @@ The matrix is also the health watchdog's truth table (ISSUE 15): the
 watchdog MUST flag, and every other (clean) row asserts it stays
 silent — a false-positive gate run after each pass.
 
+The fleet rows (ISSUE 20) extend the ladder across replicas: `fleet-
+replica-kill` kills a serve replica mid-job and asserts the job
+resumes on the survivor from the durable spool bit-identically, and
+`fleet-router-restart` restarts the ROUTER, adopts the same replicas
+from their `stats` verbs, and drains every job to the same bits.
+
 This is the SURVEY §2e fault-tolerance claim turned into a gate: it runs
 in tools/ci.sh after the telemetry smoke stage, with no accelerator
 required.
@@ -490,6 +496,137 @@ def scen_serve_backoff_storm(tmp):
     return True, f"flagged {rep.firing()} at attempt {job.attempt}"
 
 
+def _fleet_rig(tmp):
+    """Shared rig for the fleet rows: two real in-process replicas under
+    one VirtualClock behind a FleetRouter, matrix chunking on both sides
+    so the failover resume replays the exact chunk boundaries the
+    undisturbed reference used."""
+    from tpu_pbrt.fleet.router import FleetRouter, LocalReplica
+    from tpu_pbrt.utils.clock import VirtualClock
+
+    clock = VirtualClock(start=0.0, tick=1e-6)
+    fleet = [
+        LocalReplica(
+            rid, clock=clock, chunk=CHUNK,
+            spool_dir=os.path.join(tmp, rid),
+        )
+        for rid in ("r0", "r1")
+    ]
+    router = FleetRouter(
+        fleet, clock=clock, spool_dir=os.path.join(tmp, "fleet"),
+    )
+    return clock, fleet, router
+
+
+def scen_fleet_replica_kill(tmp):
+    """Fleet failover row (ISSUE 20): a replica is KILLED mid-job past a
+    durable checkpoint; the router fails the job over to the survivor,
+    which resumes from the spool — the final film must be bit-identical
+    to the undisturbed render (chunks are idempotent, the cursor is
+    durable, and film accumulation from the cursor is sequential)."""
+    from tpu_pbrt.obs.metrics import METRICS
+    from tpu_pbrt.serve.service import DONE
+
+    with _env(TPU_PBRT_CHUNK=CHUNK, TPU_PBRT_RETRY_BACKOFF="0.01"):
+        METRICS.reset()
+        _, _, router = _fleet_rig(tmp)
+        try:
+            scene, integ = _fresh()
+            job = router.submit(
+                compiled=(scene, integ), resident_key="chaos:cornell",
+                checkpoint_every=1, tenant="chaos",
+            )
+            victim = router.owner(job)
+            survivor = "r1" if victim == "r0" else "r0"
+            for _ in range(4 * N_CHUNKS):
+                if router.poll(job)["chunks_done"] >= 2:
+                    break
+                if router.step() is None:
+                    return False, "no progress before the kill"
+            else:
+                return False, "never reached chunk 2 before the kill"
+            at_kill = router.poll(job)["chunks_done"]
+            moved = router.kill_replica(victim)
+            if moved != [job]:
+                return False, f"failover moved {moved}, wanted [{job!r}]"
+            if router.owner(job) != survivor:
+                return False, (
+                    f"{job} on {router.owner(job)}, wanted {survivor}"
+                )
+            router.drain_fleet()
+            p = router.poll(job)
+            if p["status"] != DONE:
+                return False, f"job ended {p['status']!r} after failover"
+            r = router.result(job)
+        finally:
+            METRICS.reset()
+    ref_film, _ = _reference()
+    if not _identical(_film(r), ref_film):
+        return False, (
+            "failover film NOT bit-identical to undisturbed render"
+        )
+    return True, (
+        f"bit-identical after kill({victim})->resume({survivor}) "
+        f"at chunk {at_kill} ({p['failovers']} failover)"
+    )
+
+
+def scen_fleet_router_restart(tmp):
+    """Fleet restart row (ISSUE 20): the ROUTER dies between decisions
+    and a fresh one adopts the same replicas, rebuilding its routing
+    table from each replica's `stats` verb — no job is lost, the drain
+    completes every adopted job, and the films stay bit-identical."""
+    from tpu_pbrt.fleet.router import FleetRouter
+    from tpu_pbrt.obs.metrics import METRICS
+    from tpu_pbrt.serve.service import DONE
+
+    with _env(TPU_PBRT_CHUNK=CHUNK, TPU_PBRT_RETRY_BACKOFF="0.01"):
+        METRICS.reset()
+        clock, fleet, router = _fleet_rig(tmp)
+        try:
+            scene, integ = _fresh()
+            jobs = [
+                router.submit(
+                    compiled=(scene, integ),
+                    resident_key=f"chaos:cornell{i}",
+                    checkpoint_every=1, tenant="chaos",
+                )
+                for i in range(2)
+            ]
+            for _ in range(3):  # some mid-flight progress, then "crash"
+                router.step()
+            router2 = FleetRouter.adopt(
+                fleet, clock=clock,
+                spool_dir=os.path.join(tmp, "fleet"),
+            )
+            lost = [j for j in jobs if j not in router2.jobs]
+            if lost:
+                return False, f"adopt lost job(s): {lost}"
+            for j in jobs:
+                if router2.owner(j) != router.owner(j):
+                    return False, (
+                        f"adopt re-homed {j}: {router.owner(j)} -> "
+                        f"{router2.owner(j)}"
+                    )
+            router2.drain_fleet()
+            polls = {j: router2.poll(j) for j in jobs}
+            bad = {j: p["status"] for j, p in polls.items()
+                   if p["status"] != DONE}
+            if bad:
+                return False, f"adopted job(s) did not finish: {bad}"
+            films = [_film(router2.result(j)) for j in jobs]
+        finally:
+            METRICS.reset()
+    ref_film, _ = _reference()
+    for j, film in zip(jobs, films):
+        if not _identical(film, ref_film):
+            return False, f"{j}: film NOT bit-identical after restart"
+    return True, (
+        f"{len(jobs)} job(s) adopted across a router restart, "
+        "all bit-identical"
+    )
+
+
 SCENARIOS = {
     "fused-tracer": scen_fused_tracer,
     "pipeline": scen_pipeline,
@@ -506,6 +643,8 @@ SCENARIOS = {
     "mesh-device-loss": scen_mesh_device_loss,
     "serve-wedge": scen_serve_wedge,
     "serve-backoff-storm": scen_serve_backoff_storm,
+    "fleet-replica-kill": scen_fleet_replica_kill,
+    "fleet-router-restart": scen_fleet_router_restart,
 }
 
 #: rows whose whole POINT is to trip the watchdog — every other row

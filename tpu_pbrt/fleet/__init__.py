@@ -1,0 +1,39 @@
+"""tpu-fleet: replicated serve daemons behind a deterministic
+failover router (ISSUE 20 tentpole — ROADMAP new direction #1).
+
+One `RenderService` on one mesh cannot be the millions-of-users north
+star. This package is the layer above it: a front-door **router**
+spreading jobs across N serve **replicas** with
+
+- **scene-affinity consistent hashing** — a resubmit of the same scene
+  lands on the replica where the compiled scene is already resident
+  (zero scene compiles, zero jit retraces on the warm path);
+- **fleet-level SLO shedding at the edge** — the offered arrival rate
+  is compared against `knee_req_s x healthy replicas` (the `--capacity`
+  sweep's measured knee, PR 19) BEFORE any replica compiles anything;
+- **drain/failover** — a replica whose `health` verb fires wedge or
+  backoff-storm is drained; its jobs resume on another replica through
+  the durable checkpoint-v4 spool, with a double-delivery dedup window
+  so a job never renders twice.
+
+Replicas come in two flavors behind one handle interface:
+`LocalReplica` (a real in-process RenderService under an injected
+clock — the deterministic-testing shape protocheck's FleetModel and
+the load harness's `--replicas N` mode drive) and `DaemonReplica`
+(a child `python -m tpu_pbrt.serve` JSONL daemon — real deployment).
+
+Frontends: this library API and `python -m tpu_pbrt.fleet --selftest`.
+"""
+
+from tpu_pbrt.fleet.router import (
+    KNEE_REQ_S,
+    FleetPolicy,
+    FleetRouter,
+    LocalReplica,
+    fleet_size,
+)
+
+__all__ = [
+    "KNEE_REQ_S", "FleetPolicy", "FleetRouter", "LocalReplica",
+    "fleet_size",
+]

@@ -9,6 +9,9 @@ Modes:
   on any gate failure or budget overrun;
 - ``--capacity NAME`` — the arrival-rate sweep: report the knee (max
   sustainable req/s per replica at the SLO);
+- ``--replicas N`` — replay through the fleet router over N replicas
+  under one VirtualClock (decision logs stay byte-identical per
+  (spec, seed, N); the gates read fleet-wide aggregates);
 - ``--list`` — the scenario registry with specs.
 
 ``--report`` writes the deterministic JSON report (no wall times, no
@@ -74,6 +77,9 @@ def run(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--scenario", action="append", default=None,
                     help="scenario name (repeatable; default: all)")
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="fleet width: replay through the router over "
+                         "N serve replicas (default 1 = no router)")
     ap.add_argument("--ci", action="store_true",
                     help="CI smoke: gate every CI scenario + capacity "
                          "sweep under a wall budget")
@@ -141,10 +147,13 @@ def run(argv: Optional[List[str]] = None) -> int:
         "scenarios": {},
         "capacity": {},
     }
+    if args.replicas != 1:
+        report["replicas"] = args.replicas
     failed = False
     for i, name in enumerate(names):
         rep = evaluate_scenario(
             SCENARIOS[name], args.seed,
+            replicas=args.replicas,
             flight_path=args.flight_out if i == 0 else None,
             trace_path=args.trace_out if i == 0 else None,
         )

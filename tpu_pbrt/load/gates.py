@@ -69,6 +69,9 @@ class ScenarioReport:
     seed: int
     gates: List[GateResult]
     result: ReplayResult
+    #: fleet width the replay ran at (1 = the classic single-replica
+    #: engine; >1 = routed through the FleetRouter)
+    replicas: int = 1
 
     @property
     def ok(self) -> bool:
@@ -76,7 +79,11 @@ class ScenarioReport:
 
     def to_dict(self) -> Dict[str, Any]:
         r = self.result
+        extra: Dict[str, Any] = (
+            {"replicas": self.replicas} if self.replicas != 1 else {}
+        )
         return {
+            **extra,
             "scenario": self.scenario,
             "seed": self.seed,
             "ok": self.ok,
@@ -259,19 +266,27 @@ def evaluate_gates(
 def evaluate_scenario(
     scn: LoadScenario, seed: int,
     *,
+    replicas: int = 1,
     flight_path: Optional[str] = None,
     trace_path: Optional[str] = None,
 ) -> ScenarioReport:
-    """Generate + double-replay + gate one scenario. The second replay
-    exists only to feed the determinism gate; its recorders stay
-    unarmed so the flight/trace sinks hold exactly one run."""
+    """Generate + double-replay + gate one scenario (``replicas > 1``
+    routes both replays through the fleet engine — the determinism
+    gate then byte-compares routed decision logs, and the other gates
+    read fleet-wide aggregates). The second replay exists only to feed
+    the determinism gate; its recorders stay unarmed so the
+    flight/trace sinks hold exactly one run."""
     wl = generate(scn.spec, seed)
-    first = replay(wl, flight_path=flight_path, trace_path=trace_path)
-    second = replay(wl)
+    first = replay(
+        wl, replicas=replicas,
+        flight_path=flight_path, trace_path=trace_path,
+    )
+    second = replay(wl, replicas=replicas)
     gates = [gate_determinism(first, second)]
     gates.extend(evaluate_gates(first, scn.gates))
     return ScenarioReport(
         scenario=scn.spec.name, seed=seed, gates=gates, result=first,
+        replicas=replicas,
     )
 
 

@@ -24,6 +24,15 @@ per arrival; `workload_from_flight` reconstructs the exact Workload
 from those lines — or, for a log recorded by a REAL service (no
 harness lines), approximates one from the per-job ``serve_submit`` /
 ``serve_done`` heartbeats.
+
+Fleet mode (``replicas > 1``): the same schedule is driven through a
+``FleetRouter`` over N in-process ``LocalReplica``s, all under the one
+VirtualClock — submits route by scene affinity (and may shed at the
+fleet edge or at the routed replica's SLO), dispatches rotate across
+the replicas, and every decision-log line names the owning replica, so
+the byte-identity artifact is a pure function of (workload, seed, N).
+The single-replica path is byte-for-byte what it was before fleet mode
+existed — ``LOADTEST_baseline.json`` pins it.
 """
 
 from __future__ import annotations
@@ -90,13 +99,20 @@ def _stub_pair(chunks: int, depth: int):
 def replay(
     workload: Workload,
     *,
+    replicas: int = 1,
     flight_path: Optional[str] = None,
     trace_path: Optional[str] = None,
     health_every: int = 1,
 ) -> ReplayResult:
-    """Execute the schedule against a fresh RenderService. Arms the
+    """Execute the schedule against a fresh RenderService — or, with
+    ``replicas > 1``, against a FleetRouter over N of them. Arms the
     global recorders (FLIGHT/TRACE/METRICS/CHAOS) for the run and
     restores them exactly — the protocheck ProtocolModel contract."""
+    if replicas > 1:
+        return _replay_fleet(
+            workload, replicas, flight_path=flight_path,
+            trace_path=trace_path, health_every=health_every,
+        )
     from tpu_pbrt.chaos import CHAOS
     from tpu_pbrt.obs import health
     from tpu_pbrt.obs.flight import FLIGHT
@@ -251,6 +267,203 @@ def replay(
             # export INSIDE the armed window: the clock is still
             # virtual, so otherData.clock stamps "virtual" and scope's
             # --check exercises the non-wall path
+            TRACE.export(trace_path)
+        return res
+    finally:
+        CHAOS.clear()
+        FLIGHT._clock, FLIGHT._t0, FLIGHT._path = flight_prev
+        TRACE._clock, TRACE._t0, TRACE._path = trace_prev
+        if trace_path:
+            TRACE.reset()
+        METRICS._force = prev_force
+
+
+def _replay_fleet(
+    workload: Workload,
+    n_replicas: int,
+    *,
+    flight_path: Optional[str] = None,
+    trace_path: Optional[str] = None,
+    health_every: int = 1,
+) -> ReplayResult:
+    """The fleet engine: one VirtualClock, N LocalReplicas (each a real
+    RenderService with the scenario's SLO), one FleetRouter in front.
+    Same loop shape as the single-replica engine — due arrivals submit
+    (through the router: affinity routing + fleet-edge shedding +
+    per-replica SLO), otherwise `router.step()` dispatches one slice
+    somewhere in the fleet — and the same aggregate ReplayResult, with
+    the per-replica facts summed fleet-wide (pin leaks keyed by
+    replica, health flags unioned over every replica's watchdog)."""
+    from tpu_pbrt.chaos import CHAOS
+    from tpu_pbrt.fleet.router import FleetRouter, LocalReplica
+    from tpu_pbrt.obs import health
+    from tpu_pbrt.obs.flight import FLIGHT
+    from tpu_pbrt.obs.metrics import METRICS
+    from tpu_pbrt.obs.trace import TRACE
+    from tpu_pbrt.serve.queue import SloPolicy, parse_slo_spec
+    from tpu_pbrt.serve.service import DONE, FAILED, ShedError, _TERMINAL
+    from tpu_pbrt.utils.clock import VirtualClock
+
+    spec = workload.spec
+    clock = VirtualClock(start=0.0, tick=1e-6)
+    tmpdir = tempfile.mkdtemp(prefix="tpu_load_fleet_")
+    res = ReplayResult(workload=workload)
+
+    METRICS.reset()
+    prev_force = METRICS._force
+    METRICS._force = True
+    flight_prev = (FLIGHT._clock, FLIGHT._t0, FLIGHT._path)
+    FLIGHT.set_clock(clock)
+    if flight_path:
+        FLIGHT.configure(flight_path)
+    trace_prev = (TRACE._clock, TRACE._t0, TRACE._path)
+    TRACE.set_clock(clock)
+    if trace_path:
+        TRACE.configure(trace_path)
+        TRACE.reset()
+        TRACE.set_clock(clock)
+
+    fleet = [
+        LocalReplica(
+            f"r{k}", clock=clock, seed=workload.seed,
+            spool_dir=os.path.join(tmpdir, f"r{k}"),
+            max_active=spec.max_active,
+            slo=SloPolicy(
+                depth=parse_slo_spec(spec.slo_depth, int),
+                wait_s=parse_slo_spec(spec.slo_wait_s, float),
+            ),
+        )
+        for k in range(int(n_replicas))
+    ]
+    router = FleetRouter(
+        fleet, clock=clock, spool_dir=os.path.join(tmpdir, "fleet"),
+    )
+    CHAOS.install(spec.fault, workload.seed)
+
+    def _fleet_health() -> set:
+        out: set = set()
+        for rep in fleet:
+            out |= set(health.evaluate(rep.service, METRICS).firing())
+        return out
+
+    flags: set = set()
+    try:
+        if flight_path:
+            FLIGHT.heartbeat(
+                "load_run", scenario=spec.name, seed=workload.seed,
+                requests=len(workload.requests), spec=spec.to_json(),
+                replicas=n_replicas,
+            )
+        pending = sorted(workload.requests, key=lambda r: (r.t, r.rid))
+        i = 0
+        events = 0
+        while events < _MAX_EVENTS:
+            events += 1
+            now = clock.peek()
+            if i < len(pending) and pending[i].t <= now:
+                r = pending[i]
+                i += 1
+                try:
+                    router.submit(
+                        compiled=_stub_pair(r.chunks, r.depth),
+                        resident_key=r.scene, job_id=r.rid,
+                        tenant=r.tenant, priority=r.priority,
+                        checkpoint_every=r.checkpoint_every,
+                    )
+                    res.submitted += 1
+                    outcome = f"ok@{router.owner(r.rid)}"
+                except ShedError as e:
+                    res.sheds += 1
+                    outcome = f"shed:{e.reason}"
+                if flight_path:
+                    FLIGHT.heartbeat(
+                        "load_submit", rid=r.rid, at=r.t,
+                        tenant=r.tenant, prio=r.priority, scene=r.scene,
+                        chunks=r.chunks, depth=r.depth,
+                        ckpt=r.checkpoint_every, kind=r.kind,
+                        outcome=outcome,
+                    )
+                res.log.append(
+                    f"@{now:012.6f} submit {r.rid} tenant={r.tenant} "
+                    f"prio={r.priority} scene={r.scene} -> {outcome}"
+                )
+            else:
+                got = router.step()
+                res.steps += 1
+                if got is None:
+                    if i < len(pending):
+                        clock.advance_to(pending[i].t)
+                        res.log.append(
+                            f"@{clock.peek():012.6f} advance"
+                        )
+                    elif all(rep.service.idle() for rep in fleet):
+                        break
+                    else:
+                        # fleet wedge: step every replica's service
+                        # directly (router.step() short-circuits when
+                        # nothing is dispatchable, so the per-replica
+                        # watchdog gap counters only advance on direct
+                        # steps) until the wedge threshold crosses,
+                        # then stop with the flag as evidence
+                        th = health.Thresholds()
+                        for _ in range(th.resolved_wedge_steps() + 2):
+                            for rep in fleet:
+                                rep.service.step()
+                            flags |= _fleet_health()
+                        res.log.append(
+                            f"@{clock.peek():012.6f} wedge"
+                        )
+                        break
+                else:
+                    rid, job = got
+                    res.dispatches += 1
+                    cur = router.replicas[rid].service.jobs[job].cursor
+                    res.log.append(
+                        f"@{clock.peek():012.6f} step -> {rid}/{job}:c{cur}"
+                    )
+                    clock.advance(spec.service_time_s)
+            if events % max(1, health_every) == 0:
+                flags |= _fleet_health()
+        flags |= _fleet_health()
+
+        res.health_flags = sorted(flags)
+        res.virtual_seconds = round(clock.peek(), 6)
+        statuses: Dict[str, str] = {}
+        for job_id, rec in router.jobs.items():
+            st = rec.terminal
+            if not st:
+                rep = router.replicas.get(rec.rid)
+                st = (
+                    rep.status(job_id)
+                    if rep is not None and rep.alive else None
+                )
+            statuses[job_id] = st or ""
+        res.completed = sum(1 for s in statuses.values() if s == DONE)
+        res.failed = sum(1 for s in statuses.values() if s == FAILED)
+        res.unfinished = sorted(
+            j for j, s in statuses.items() if s not in _TERMINAL
+        )
+        res.pin_leaks = {
+            f"{rep.rid}:{k}": n
+            for rep in fleet
+            for k, n in rep.service.residency.pin_counts().items() if n
+        }
+        res.compiles = sum(
+            rep.service.residency.scene_compiles for rep in fleet
+        )
+        res.residency_hits = sum(
+            rep.service.residency.hits for rep in fleet
+        )
+        res.evictions = sum(
+            rep.service.residency.evictions for rep in fleet
+        )
+        res.snapshot = METRICS.snapshot()
+        res.preemptions = int(sum(
+            s["value"] for s in res.snapshot["metrics"].get(
+                "tpu_pbrt_serve_preemptions_total", {},
+            ).get("series", ())
+        ))
+        if trace_path:
             TRACE.export(trace_path)
         return res
     finally:

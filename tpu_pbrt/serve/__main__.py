@@ -19,7 +19,23 @@ Ops:
   {"op": "stats"}
   {"op": "metrics", "out": "metrics.prom?"}   # Prometheus text exposition
   {"op": "health"}                    # watchdog verdict (obs/health.py)
+  {"op": "drain"}                     # stop admitting; park active jobs;
+                                      # reports when the spool is quiescent
   {"op": "shutdown", "drain": true}
+
+A submit may carry {"trace": "t:<id>"} — a caller-supplied trace
+context (the fleet router's hop): the job's spans carry that id, but
+the root serve/job span is owned by the caller, so a failover
+re-submit on another daemon continues one end-to-end timeline.
+
+`drain` (ISSUE 20) is the router's graceful-failover primitive, which
+`shutdown` cannot provide: the daemon STAYS UP — answering polls,
+stats, results — while every new submit is deterministically shed and
+the runnable jobs park through the emergency-checkpoint path. The
+response carries {"quiescent": true/false, "parked": [...], "spool":
+{job: {checkpoint, cursor, durable}}}; once quiescent, every parked
+job's durable spool entry holds the exact resumable tuple another
+replica can adopt.
 
 A submit rejected by SLO admission control (TPU_PBRT_SERVE_SLO_DEPTH /
 _WAIT_S, or --slo-depth/--slo-wait-s) answers {"ok": false, "shed":
@@ -162,6 +178,7 @@ def _handle(service, req, out):
                     preview_every=int(req.get("preview_every", 0)),
                     preview_path=req.get("preview", ""),
                     outfile=req.get("outfile", ""),
+                    trace_id=req.get("trace"),
                 )
             except ShedError as e:
                 # SLO load shedding: a first-class protocol answer, not
@@ -237,6 +254,11 @@ def _handle(service, req, out):
             from tpu_pbrt.obs.health import evaluate
 
             _emit(out, {"ok": True, "op": op, **evaluate(service).to_dict()})
+        elif op == "drain":
+            # graceful handoff: shed new submits, park runnable jobs,
+            # report the spool manifest — the daemon keeps serving
+            # polls/results so a router can adopt the spool elsewhere
+            _emit(out, {"ok": True, "op": op, **service.begin_drain()})
         elif op == "shutdown":
             return "drain" if req.get("drain", True) else "now"
         else:
@@ -466,6 +488,50 @@ def selftest(args) -> int:
     except ShedError:
         fails.append("submit still shed after the queue drained")
     service.slo = SloPolicy()
+
+    # drain verb (ISSUE 20): the fleet router's graceful-failover
+    # primitive — the service stops admitting, parks its runnable jobs
+    # through the emergency-checkpoint path, and reports the spool
+    # manifest another replica could adopt; the daemon stays up
+    import io
+
+    say("drain handoff (park + shed + spool manifest)")
+    j5 = service.submit(text=text, options=opts, tenant="alice",
+                        checkpoint_every=1)
+    service.step()
+    buf = io.StringIO()
+    _handle(service, {"op": "drain"}, buf)
+    ans = json.loads(buf.getvalue())
+    if not (ans.get("ok") and ans.get("draining")):
+        fails.append(f"drain verb answered {ans}")
+    if j5 not in ans.get("parked", []) or j5 not in ans.get("spool", {}):
+        fails.append(f"drain did not park+spool {j5}: {ans}")
+    elif not ans["spool"][j5]["durable"]:
+        fails.append(f"drain left {j5} without a durable spool entry")
+    if not ans.get("quiescent"):
+        fails.append(f"drain reports non-quiescent after parking: {ans}")
+    try:
+        service.submit(text=text, options=opts, tenant="alice")
+        fails.append("draining service admitted a submit")
+    except ShedError as e:
+        if "draining" not in e.reason:
+            fails.append(f"draining shed carries wrong reason: {e.reason}")
+    buf = io.StringIO()
+    _handle(service, {"op": "submit", "text": text}, buf)
+    shed_ans = json.loads(buf.getvalue())
+    if not shed_ans.get("shed"):
+        fails.append(
+            f"daemon answered a draining submit without shed: {shed_ans}"
+        )
+    # the handoff is reversible: lift the drain, resume the parked job
+    # from its durable checkpoint, and the film is still bit-identical
+    service.draining = False
+    service.resume(j5)
+    service.drain()
+    if not np.array_equal(
+        np.asarray(service.result(j5).image, np.float32), ref
+    ):
+        fails.append("film resumed after a drain differs from solo")
 
     # metrics exposition (ISSUE 10): the scrape page must lint clean and
     # carry the per-tenant queue-wait/service-time histograms + the shed

@@ -199,6 +199,12 @@ class RenderJob:
     #: deterministic request trace id ("t:<job_id>") every span, flight
     #: line, and histogram exemplar this job produces carries
     trace_id: str = ""
+    #: this service minted the trace id and owns the root span's
+    #: begin/end pair. False when a caller (the fleet router) supplied
+    #: the trace context: the job's slices/waits still carry the id,
+    #: but the root span opens and closes exactly once AT THE CALLER —
+    #: a failover re-submit on another replica must not re-open it
+    trace_owned: bool = True
     #: queue-wait episodes opened so far (the per-episode async-span id
     #: suffix: "<trace_id>/q<epoch>")
     wait_epoch: int = 0
@@ -333,6 +339,10 @@ class RenderService:
         #: submits answered with a shed (the deterministic count the
         #: selftest pins; the labeled breakdown lives in the registry)
         self.sheds = 0
+        #: drain handoff (fleet router): a draining service sheds every
+        #: new submit and parks its runnable jobs so the durable spool
+        #: can be re-routed to another replica (begin_drain())
+        self.draining = False
         #: the dispatch record [(job_id, chunk_index), ...] — the
         #: deterministic-interleaving evidence tests assert on
         self.schedule: List[tuple] = []
@@ -367,19 +377,30 @@ class RenderService:
         preview_every: int = 0,
         preview_path: str = "",
         outfile: str = "",
+        trace_id: Optional[str] = None,
     ) -> str:
         """Submit a render: a .pbrt file `path`, inline scene `text`, or
         a precompiled (scene, integrator) pair. Returns the job id.
         Scene compilation happens HERE (once per resident key — a warm
         key is a cache hit); no rendering happens until `step`.
 
+        `trace_id` is the caller-supplied trace context (the fleet
+        router's hop): when set, the job's spans carry that id but the
+        ROOT async span is owned by the caller — this service neither
+        opens nor closes it, so a failover re-submit on another replica
+        continues the same request timeline without a duplicate root.
+
         Raises ShedError WITHOUT compiling or queuing anything when the
         SLO admission policy says the request's priority class is
         already over its queue-depth or queue-wait target — shedding
         after the compile would spend the exact resources shedding
-        exists to protect."""
+        exists to protect. A draining service (begin_drain()) sheds
+        every submit the same way: nothing is compiled or queued."""
         from tpu_pbrt.obs.trace import TRACE
 
+        if self.draining:
+            self._shed(tenant, int(priority),
+                       "draining: service is handing off its spool")
         if self.slo.enabled():
             self._admit_or_shed(tenant, int(priority))
         if options is None:
@@ -465,14 +486,18 @@ class RenderService:
         )
         job.ready_t = self._now()
         self.jobs[job_id] = job
-        # tpu-scope: the job's trace context. The root async span opens
-        # here and closes at the terminal outcome; every span the job
-        # produces in between carries trace_id in its args
-        job.trace_id = TRACE.trace_id(job_id)
-        TRACE.async_begin(
-            "serve/job", id=job.trace_id, cat="job", job=job_id,
-            tenant=tenant, priority=job.priority, trace_id=job.trace_id,
-        )
+        # tpu-scope: the job's trace context. With no caller-supplied
+        # id the root async span opens here and closes at the terminal
+        # outcome; a router-minted id means the root pair lives at the
+        # router and every span here just carries the id in its args
+        job.trace_owned = trace_id is None
+        job.trace_id = trace_id if trace_id else TRACE.trace_id(job_id)
+        if job.trace_owned:
+            TRACE.async_begin(
+                "serve/job", id=job.trace_id, cat="job", job=job_id,
+                tenant=tenant, priority=job.priority,
+                trace_id=job.trace_id,
+            )
         self._trace_ready(job)
         METRICS.counter(
             "serve_submits_total", "jobs admitted by submit"
@@ -503,6 +528,11 @@ class RenderService:
         ok, reason = self.slo.admit(priority, depth, wait_p90)
         if ok:
             return
+        self._shed(tenant, priority, reason)
+
+    def _shed(self, tenant: str, priority: int, reason: str) -> None:
+        """Count + flight-log + raise one shed answer (SLO admission
+        breaches and the drain handoff share the same refusal path)."""
         self.sheds += 1
         METRICS.counter(
             "serve_shed_total",
@@ -757,6 +787,37 @@ class RenderService:
         self._update_depth_gauge()
         self._flight(job, "serve_resume", chunk=job.cursor)
 
+    def begin_drain(self) -> Dict[str, Any]:
+        """Quiesce for handoff (the daemon's `drain` verb and the fleet
+        router's graceful-failover primitive): stop admitting — every
+        later submit is answered with a deterministic shed — and park
+        every runnable job through the emergency-checkpoint path, so
+        each one's durable spool entry holds the exact resumable tuple
+        another replica can adopt. Returns the spool manifest:
+        quiescent means every job is terminal or parked with its
+        checkpoint state reported (the "spool quiescent" signal the
+        verb's caller polls for). Idempotent."""
+        self.draining = True
+        parked: List[str] = []
+        for j in list(self.jobs.values()):
+            if j.status in _RUNNABLE:
+                self.preempt(j.job_id)
+                parked.append(j.job_id)
+        spool: Dict[str, Any] = {}
+        for j in self.jobs.values():
+            if j.status == PAUSED:
+                spool[j.job_id] = {
+                    "checkpoint": j.checkpoint_path,
+                    "cursor": j.cursor,
+                    "durable": checkpoint_exists(j.checkpoint_path),
+                }
+        return {
+            "draining": True,
+            "quiescent": self.idle(),
+            "parked": parked,
+            "spool": spool,
+        }
+
     def cancel(self, job_id: str) -> None:
         """Terminal cancel: frees the film state, releases the residency
         pin (an unpinned scene is evictable), and removes the
@@ -788,6 +849,7 @@ class RenderService:
                 job.plan.n_chunks if job.plan
                 else (job.chunks_total or None)
             ),
+            "scene": job.resident_key,
             "preemptions": job.preemptions,
             "redispatches": job.redispatches,
             "previews": job.previews,
@@ -895,6 +957,11 @@ class RenderService:
             return
         job.trace_done = True
         self._trace_wait_end(job)
+        if not job.trace_owned:
+            # router-supplied context: the caller owns the root pair —
+            # it closes the span once the JOB (not this instance of it)
+            # reaches its fleet-wide terminal outcome
+            return
         TRACE.async_end(
             "serve/job", id=job.trace_id, cat="job", outcome=outcome,
             chunks=job.cursor,
